@@ -1,0 +1,23 @@
+"""indy_plenum_tpu — a TPU-native RBFT ordering service.
+
+A ground-up redesign (NOT a port) of the capabilities of
+hyperledger/indy-plenum (reference layout surveyed in SURVEY.md):
+
+- **Host runtime** (pure Python, deterministic, single event loop per node):
+  timers, event buses, stashing routers, message schemas, ledgers, MPT state,
+  catchup / view-change / checkpoint state machines. Mirrors reference layers
+  L1/L4/L5/L6 (`stp_core/loop/`, `plenum/common/`, `plenum/server/`) at a
+  fraction of the size.
+- **Device plane** (JAX/XLA/Pallas, `ops/` + `parallel/` + `models/`): all
+  O(n_validators x batch) math — batched Ed25519 verification
+  (reference hot path: `plenum/server/client_authn.py::CoreAuthNr.authenticate`),
+  SHA-256 Merkle audit-path verification (reference:
+  `ledger/merkle_verifier.py`), and the dense (validator x seqNo) quorum vote
+  tally (reference: `plenum/server/consensus/ordering_service.py`) reduced
+  with `psum` over a `jax.sharding.Mesh` whose axis mirrors the validator set.
+
+Only boolean verdicts / quorum events cross back from device to the Python
+replica loop.
+"""
+
+__version__ = "0.1.0"

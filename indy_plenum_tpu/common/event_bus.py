@@ -1,0 +1,84 @@
+"""In-process pub/sub buses.
+
+Reference: plenum/common/event_bus.py (`InternalBus`, `ExternalBus`).
+`InternalBus` carries typed events between consensus services inside one
+node; `ExternalBus` abstracts "send a message to the network" so services
+never touch sockets — in production it is wired to the ZMQ node stack, in
+simulation to the in-memory network (`indy_plenum_tpu.simulation`).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Callable, NamedTuple
+
+
+class InternalBus:
+    """Synchronous typed pub/sub: subscribers keyed by message class."""
+
+    def __init__(self):
+        self._handlers: dict[type, list[Callable]] = defaultdict(list)
+
+    def subscribe(self, message_type: type, handler: Callable) -> None:
+        self._handlers[message_type].append(handler)
+
+    def unsubscribe(self, message_type: type, handler: Callable) -> None:
+        if handler in self._handlers.get(message_type, []):
+            self._handlers[message_type].remove(handler)
+
+    def send(self, message: Any, *args) -> None:
+        # Walk the MRO so handlers may subscribe to base classes; a handler
+        # subscribed at several levels still fires at most once per send.
+        seen = []
+        for cls in type(message).__mro__:
+            for handler in tuple(self._handlers.get(cls, ())):
+                if handler not in seen:  # == dedupes equal bound methods too
+                    seen.append(handler)
+                    handler(message, *args)
+
+
+class ExternalBus:
+    """Network abstraction handed to consensus services.
+
+    ``send_handler(msg, dst)`` with dst=None means broadcast to all
+    connected peers. Inbound messages are delivered via ``process_incoming``.
+    Connection state is tracked so services (e.g. the primary-connection
+    monitor) can ask who is reachable.
+    """
+
+    class Connected(NamedTuple):
+        name: str
+
+    class Disconnected(NamedTuple):
+        name: str
+
+    def __init__(self, send_handler: Callable[[Any, str | None], None]):
+        self._send_handler = send_handler
+        self._handlers: dict[type, list[Callable]] = defaultdict(list)
+        self._connecteds: set[str] = set()
+
+    @property
+    def connecteds(self) -> set[str]:
+        return set(self._connecteds)
+
+    def subscribe(self, message_type: type, handler: Callable) -> None:
+        self._handlers[message_type].append(handler)
+
+    def send(self, message: Any, dst: str | list[str] | None = None) -> None:
+        self._send_handler(message, dst)
+
+    def process_incoming(self, message: Any, frm: str) -> None:
+        seen = []
+        for cls in type(message).__mro__:
+            for handler in tuple(self._handlers.get(cls, ())):
+                if handler not in seen:  # == dedupes equal bound methods too
+                    seen.append(handler)
+                    handler(message, frm)
+
+    def update_connecteds(self, connecteds: set[str]) -> None:
+        added = connecteds - self._connecteds
+        removed = self._connecteds - connecteds
+        self._connecteds = set(connecteds)
+        for name in sorted(added):
+            self.process_incoming(self.Connected(name), name)
+        for name in sorted(removed):
+            self.process_incoming(self.Disconnected(name), name)

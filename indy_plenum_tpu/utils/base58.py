@@ -1,0 +1,36 @@
+"""Minimal base58 (bitcoin alphabet) codec.
+
+The environment has no ``base58`` package; identifiers, verkeys and merkle
+roots are base58-encoded on the wire exactly as in the reference
+(plenum/common/messages/fields.py `Base58Field`, `MerkleRootField`).
+"""
+from __future__ import annotations
+
+ALPHABET = b"123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_INDEX = {c: i for i, c in enumerate(ALPHABET)}
+
+
+def b58encode(data: bytes) -> str:
+    n_zeros = len(data) - len(data.lstrip(b"\0"))
+    num = int.from_bytes(data, "big")
+    out = bytearray()
+    while num > 0:
+        num, rem = divmod(num, 58)
+        out.append(ALPHABET[rem])
+    out.extend(ALPHABET[0:1] * n_zeros)
+    out.reverse()
+    return out.decode("ascii")
+
+
+def b58decode(text: str | bytes) -> bytes:
+    if isinstance(text, str):
+        text = text.encode("ascii")
+    n_zeros = len(text) - len(text.lstrip(ALPHABET[0:1]))
+    num = 0
+    for ch in text:
+        try:
+            num = num * 58 + _INDEX[ch]
+        except KeyError:
+            raise ValueError(f"invalid base58 character {ch!r}") from None
+    body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
+    return b"\0" * n_zeros + body

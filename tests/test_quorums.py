@@ -1,0 +1,52 @@
+"""Tier-1 unit tests: quorum thresholds (reference: plenum/test of quorums)."""
+import pytest
+
+from indy_plenum_tpu.server.quorums import Quorums
+
+
+@pytest.mark.parametrize("n,f", [(1, 0), (4, 1), (7, 2), (10, 3), (13, 4),
+                                 (25, 8), (64, 21), (100, 33)])
+def test_f_from_n(n, f):
+    assert Quorums(n).f == f
+
+
+def test_thresholds_n4():
+    q = Quorums(4)
+    assert q.propagate.value == 2
+    assert q.prepare.value == 2
+    assert q.commit.value == 3
+    assert q.checkpoint.value == 2  # counts only others' CHECKPOINTs
+    assert q.view_change.value == 3
+    assert q.weak.value == 2
+    assert q.strong.value == 3
+    assert q.reply.value == 2
+    assert q.bls_signatures.value == 3
+
+
+def test_thresholds_n7():
+    q = Quorums(7)
+    assert q.propagate.value == 3
+    assert q.prepare.value == 4
+    assert q.commit.value == 5
+    assert q.ledger_status.value == 4
+
+
+def test_is_reached():
+    q = Quorums(4)
+    assert not q.commit.is_reached(2)
+    assert q.commit.is_reached(3)
+    assert q.commit.is_reached(4)
+
+
+def test_strong_majority_overlap():
+    # Any two strong quorums intersect in at least f+1 nodes -> at least one
+    # honest node, the core BFT safety argument.
+    for n in range(4, 101):
+        q = Quorums(n)
+        overlap = 2 * q.strong.value - n
+        assert overlap >= q.f + 1
+
+
+def test_invalid_n():
+    with pytest.raises(ValueError):
+        Quorums(0)

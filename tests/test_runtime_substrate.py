@@ -1,0 +1,189 @@
+"""Tier-1 unit tests: timer, buses, stashing router."""
+from indy_plenum_tpu.common.event_bus import ExternalBus, InternalBus
+from indy_plenum_tpu.common.stashing_router import (
+    DISCARD, PROCESS, STASH_CATCH_UP, StashingRouter,
+)
+from indy_plenum_tpu.common.timer import QueueTimer, RepeatingTimer
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_queue_timer_fires_in_order():
+    clock = FakeClock()
+    timer = QueueTimer(clock)
+    fired = []
+    timer.schedule(5.0, lambda: fired.append("b"))
+    timer.schedule(1.0, lambda: fired.append("a"))
+    clock.now = 0.5
+    assert timer.service() == 0
+    clock.now = 1.0
+    assert timer.service() == 1
+    clock.now = 10.0
+    assert timer.service() == 1
+    assert fired == ["a", "b"]
+
+
+def test_queue_timer_cancel():
+    clock = FakeClock()
+    timer = QueueTimer(clock)
+    fired = []
+    cb = lambda: fired.append(1)  # noqa: E731
+    timer.schedule(1.0, cb)
+    timer.schedule(2.0, cb)
+    timer.cancel(cb)
+    clock.now = 5.0
+    assert timer.service() == 0
+    assert fired == []
+
+
+def test_repeating_timer():
+    clock = FakeClock()
+    timer = QueueTimer(clock)
+    fired = []
+    rt = RepeatingTimer(timer, 2.0, lambda: fired.append(clock.now))
+    for t in (2.0, 4.0, 6.0):
+        clock.now = t
+        timer.service()
+    rt.stop()
+    clock.now = 8.0
+    timer.service()
+    assert fired == [2.0, 4.0, 6.0]
+
+
+def test_internal_bus_mro_dispatch():
+    class Base:
+        pass
+
+    class Derived(Base):
+        pass
+
+    bus = InternalBus()
+    got = []
+    bus.subscribe(Base, lambda m: got.append(("base", m)))
+    bus.subscribe(Derived, lambda m: got.append(("derived", m)))
+    msg = Derived()
+    bus.send(msg)
+    assert ("derived", msg) in got and ("base", msg) in got
+
+
+def test_external_bus_connecteds():
+    sent = []
+    bus = ExternalBus(lambda msg, dst: sent.append((msg, dst)))
+    events = []
+    bus.subscribe(ExternalBus.Connected, lambda m, frm: events.append(("+", m.name)))
+    bus.subscribe(ExternalBus.Disconnected, lambda m, frm: events.append(("-", m.name)))
+    bus.update_connecteds({"Alpha", "Beta"})
+    bus.update_connecteds({"Beta"})
+    assert ("+", "Alpha") in events and ("+", "Beta") in events
+    assert ("-", "Alpha") in events
+    bus.send("hello", "Beta")
+    assert sent == [("hello", "Beta")]
+
+
+def test_stashing_router_roundtrip():
+    class Msg:
+        def __init__(self, ready):
+            self.ready = ready
+
+    router = StashingRouter(limit=10)
+    processed = []
+    ready = {"flag": False}
+
+    def handler(msg, frm):
+        if not ready["flag"] and not msg.ready:
+            return STASH_CATCH_UP
+        processed.append((msg, frm))
+        return PROCESS
+
+    router.subscribe(Msg, handler)
+    m1, m2 = Msg(False), Msg(True)
+    assert router.process(m1, "A") == STASH_CATCH_UP
+    assert router.process(m2, "B") == PROCESS
+    assert router.stash_size() == 1
+    ready["flag"] = True
+    assert router.process_stashed(STASH_CATCH_UP) == 1
+    assert processed == [(m2, "B"), (m1, "A")]
+
+
+def test_stashing_router_discard_and_bound():
+    class Msg:
+        pass
+
+    router = StashingRouter(limit=2)
+    router.subscribe(Msg, lambda m: (DISCARD, "bad"))
+    assert router.process(Msg()) == DISCARD
+
+    router2 = StashingRouter(limit=2)
+    router2.subscribe(Msg, lambda m: STASH_CATCH_UP)
+    for _ in range(5):
+        router2.process(Msg())
+    assert router2.stash_size(STASH_CATCH_UP) == 2
+
+
+def test_base58_roundtrip():
+    from indy_plenum_tpu.utils.base58 import b58decode, b58encode
+
+    for raw in (b"", b"\0\0abc", b"hello world", bytes(range(32))):
+        assert b58decode(b58encode(raw)) == raw
+    # Known vector
+    assert b58encode(b"hello") == "Cn8eVZg"
+
+
+def test_queue_timer_zero_delay_reschedule_does_not_hang():
+    # A 0-delay self-rescheduling callback under a frozen virtual clock must
+    # fire once per service() pass, not loop forever.
+    clock = FakeClock()
+    timer = QueueTimer(clock)
+    count = []
+
+    def tick():
+        count.append(1)
+        timer.schedule(0.0, tick)
+
+    timer.schedule(0.0, tick)
+    clock.now = 1.0
+    assert timer.service() == 1
+    assert timer.service() == 1
+    assert len(count) == 2
+
+
+def test_repeating_timer_restart_inside_callback_single_chain():
+    clock = FakeClock()
+    timer = QueueTimer(clock)
+    fired = []
+    rt_box = {}
+
+    def watchdog():
+        fired.append(clock.now)
+        rt_box["rt"].stop()
+        rt_box["rt"].start()  # watchdog reset must not double the chain
+
+    rt_box["rt"] = RepeatingTimer(timer, 2.0, watchdog)
+    for t in (2.0, 4.0, 6.0):
+        clock.now = t
+        timer.service()
+    assert fired == [2.0, 4.0, 6.0]
+    assert timer.queue_size() == 1  # exactly one live chain
+
+
+def test_stashing_router_no_double_dispatch_via_bus():
+    class Base:
+        pass
+
+    class Derived(Base):
+        pass
+
+    bus = InternalBus()
+    router = StashingRouter(limit=10, buses=[bus])
+    got = []
+    router.subscribe(Base, lambda m: got.append("base") or PROCESS)
+    router.subscribe(Derived, lambda m: got.append("derived") or PROCESS)
+    bus.send(Derived())
+    # Router resolves to the most-derived handler, exactly once.
+    assert got == ["derived"]

@@ -1,0 +1,71 @@
+"""Benchmark driver entry: prints ONE JSON line with the headline metric.
+
+Headline metric (BASELINE.md config 2 / north star): batched Ed25519
+signature verifications per second per chip, measured on the device the
+driver provides (real TPU under axon; CPU otherwise).
+
+Baseline: libsodium Ed25519 verify on one CPU core is ~15-30k ops/sec
+(BASELINE.md provenance note; the reference publishes no numbers). We use
+25k/sec as the reference point for ``vs_baseline``.
+"""
+import json
+import sys
+import time
+
+BASELINE_CPU_VERIFIES_PER_SEC = 25_000.0
+BATCH = 2048
+REPS = 5
+
+
+def main() -> None:
+    import numpy as np
+
+    from indy_plenum_tpu.crypto import ed25519 as ed
+    from indy_plenum_tpu.tpu import ed25519 as ted
+
+    rng = np.random.RandomState(7)
+    seeds = [rng.bytes(32) for _ in range(64)]
+    pks_all = [ed.fast_public_key(s) for s in seeds]
+    pks, msgs, sigs = [], [], []
+    for i in range(BATCH):
+        seed = seeds[i % len(seeds)]
+        msg = rng.bytes(64)
+        pks.append(pks_all[i % len(seeds)])
+        msgs.append(msg)
+        sigs.append(ed.fast_sign(seed, msg))
+
+    import jax
+    import jax.numpy as jnp
+
+    pk_a, r_a, s_a, h_a, pre = ted.prepare_batch(pks, msgs, sigs)
+    assert pre.all()
+    args = [jax.device_put(jnp.asarray(a)) for a in (pk_a, r_a, s_a, h_a)]
+
+    ok = np.asarray(ted.verify_kernel(*args))  # compile + warm
+    assert ok.all(), "benchmark batch failed verification"
+
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        ted.verify_kernel(*args).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    value = BATCH / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "verifies/sec",
+                "vs_baseline": round(value / BASELINE_CPU_VERIFIES_PER_SEC, 3),
+                "batch": BATCH,
+                "best_ms": round(best * 1e3, 2),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

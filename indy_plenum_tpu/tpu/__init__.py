@@ -1,0 +1,12 @@
+"""Device plane: JAX/XLA/Pallas kernels for the crypto + quorum hot paths.
+
+This package is the TPU-native replacement for the reference's native crypto
+stack (libsodium via ``stp_core/crypto/nacl_wrappers.py``, indy-crypto BLS via
+``crypto/bls/indy_crypto/bls_crypto_indy_crypto.py``) and for the per-message
+Python quorum bookkeeping in ``plenum/server/consensus/ordering_service.py``.
+
+Everything here is pure-functional JAX: batched over the in-flight 3PC
+request/message batch, shardable over a ``jax.sharding.Mesh`` whose axis
+mirrors the validator set. All arithmetic is int32 (native TPU VPU lanes —
+no 64-bit emulation anywhere on the hot path).
+"""

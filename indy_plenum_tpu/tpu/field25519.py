@@ -1,0 +1,298 @@
+"""GF(2^255 - 19) field arithmetic as batched JAX limb vectors (int32).
+
+TPU-native replacement for the field layer inside libsodium's ref10
+(reference consumes it via ``stp_core/crypto/nacl_wrappers.py``). Design:
+
+- A field element is a little-endian vector of ``NLIMBS = 22`` limbs in radix
+  ``2^12``, dtype **int32** — native TPU VPU arithmetic, no 64-bit emulation.
+  Batching is a leading axis: ``(..., 22)``; all ops are elementwise over the
+  batch and vectorize on the VPU with no data-dependent control flow.
+- Bounds: ops accept "loose" limbs (<= 2^13) and return loose limbs
+  (<= 2^12 + epsilon). Schoolbook products of loose limbs are < 2^26 and
+  column sums < 22 * 2^26 < 2^31, so int32 never overflows.
+- Carry propagation is *parallel* (all limbs emit carries simultaneously,
+  carries shift up one position, top carry folds by 2^264 = 9728 (mod p)):
+  a few O(1)-depth vector passes instead of a sequential 22-step chain —
+  this keeps both XLA compile time and the critical path short.
+- Only :func:`freeze` produces canonical limbs (one sequential ripple pass +
+  conditional subtracts); equality/encoding go through it.
+
+Exponentiation chains (inversion, sqrt) run as ``lax.scan`` over static
+exponent bits so the traced program stays small.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+P = 2**255 - 19
+NLIMBS = 22
+RADIX = 12
+MASK = (1 << RADIX) - 1
+# 2^(12*22) = 2^264 == 2^9 * 19 = 9728 (mod p)
+TOP_FOLD = (1 << (RADIX * NLIMBS)) % P
+assert TOP_FOLD == 9728
+
+# d = -121665/121666 mod p (edwards25519 curve constant)
+D = 37095705934669439343138083508754565189542113879843219016388785533085940283555
+D2 = (2 * D) % P
+# sqrt(-1) mod p
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    out = np.zeros(NLIMBS, dtype=np.int32)
+    for i in range(NLIMBS):
+        out[i] = (x >> (RADIX * i)) & MASK
+    return out
+
+
+def int_from_limbs(limbs) -> int:
+    arr = np.asarray(limbs, dtype=object).reshape(-1)
+    return sum(int(arr[i]) << (RADIX * i) for i in range(NLIMBS)) % P
+
+
+def _make_kp_limbwise() -> np.ndarray:
+    """Multiple of p with every limb in [4*2^12 - 4, 2^17+]: subtrahend-safe.
+
+    ``sub(a, b) = carry(a + K - b)`` never goes negative limb-wise for any
+    loose ``b`` (loose limbs stay well under 2^13; every K limb is ~2^14).
+    """
+    k = (1 << 14) * P  # top radix-12 limb holds the 2^17 overflow bits
+    limbs = np.zeros(NLIMBS, dtype=np.int64)
+    for i in range(NLIMBS - 1):
+        limbs[i] = (k >> (RADIX * i)) & MASK
+    limbs[NLIMBS - 1] = k >> (RADIX * (NLIMBS - 1))
+    for i in range(NLIMBS - 1):
+        limbs[i] += 4 << RADIX
+        limbs[i + 1] -= 4
+    assert (limbs >= (4 << RADIX) - 4).all(), limbs
+    assert (limbs <= (1 << 17)).all(), limbs
+    assert sum(int(l) << (RADIX * i) for i, l in enumerate(limbs)) == k
+    return limbs.astype(np.int32)
+
+
+_KP_LIMBS = _make_kp_limbwise()
+
+ZERO = limbs_from_int(0)
+ONE = limbs_from_int(1)
+D_LIMBS = limbs_from_int(D)
+D2_LIMBS = limbs_from_int(D2)
+SQRT_M1_LIMBS = limbs_from_int(SQRT_M1)
+P_LIMBS = limbs_from_int(P)
+
+
+# Top-fold constants, split so every contribution stays far below 2^31:
+# 2^264 = 9728 = 2*2^12 + 1536 (mod p); 2^276 = 9728 * 2^12 (mod p).
+_FOLD_L0 = TOP_FOLD & MASK  # 1536, into limb 0
+_FOLD_L1 = TOP_FOLD >> RADIX  # 2, into limb 1
+assert _FOLD_L1 * (1 << RADIX) + _FOLD_L0 == TOP_FOLD
+assert (TOP_FOLD << RADIX) % P == TOP_FOLD * (1 << RADIX)  # 2^276 mod p
+
+
+def _parallel_carry_pass(c: jnp.ndarray) -> jnp.ndarray:
+    """All limbs emit carries at once; carries shift up; top carry folds.
+
+    Safe for any non-negative int32 input (limbs < 2^31): the top carry
+    (< 2^19) is split into 12-bit halves before scaling, so every fold
+    contribution is < 2^23. Repeated passes converge to loose limbs
+    (<= 2^12 + 1) in at most 4 passes from 2^31, 2 passes from 2^14.
+    """
+    cr = c >> RADIX
+    lo = c & MASK
+    top = cr[..., -1]
+    e_lo = top & MASK
+    e_hi = top >> RADIX
+    shifted = jnp.concatenate([jnp.zeros_like(cr[..., :1]), cr[..., :-1]], axis=-1)
+    out = lo + shifted
+    out = out.at[..., 0].add(e_lo * _FOLD_L0)
+    out = out.at[..., 1].add(e_lo * _FOLD_L1 + e_hi * TOP_FOLD)
+    return out
+
+
+def carry(c: jnp.ndarray, passes: int = 4) -> jnp.ndarray:
+    """Fast loose carry: limbs out <= 2^12 (+1 ripple), inputs < 2^31.
+
+    Output limbs are <= 2^12 (a limb may be exactly 2^12 in rare ripple
+    cases) — "loose", accepted by every op here. :func:`freeze` is strict.
+    """
+    for _ in range(passes):
+        c = _parallel_carry_pass(c)
+    return c
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b, passes=2)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + jnp.asarray(_KP_LIMBS) - b, passes=2)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return carry(jnp.asarray(_KP_LIMBS) - a, passes=2)
+
+
+def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook columns: (..., 2*NLIMBS-1) int32, each < 2^31."""
+    pad_cfg_base = tuple((0, 0) for _ in range(a.ndim - 1))
+    terms = []
+    for i in range(NLIMBS):
+        prod = a[..., i : i + 1] * b  # (..., 22), each < 2^26
+        terms.append(jnp.pad(prod, pad_cfg_base + ((i, NLIMBS - 1 - i),)))
+    return sum(terms)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Product of loose elements; output loose (<= 2^12)."""
+    wide = _mul_wide(a, b)
+    lo = wide[..., :NLIMBS]
+    hi = wide[..., NLIMBS:]  # 21 columns, < 2^31
+    # Carry the high part down to loose limbs before scaling by 9728 so the
+    # fold stays within int32 (9728 * 2^13 < 2^27; lo + that < 2^31).
+    pad_cfg = tuple((0, 0) for _ in range(hi.ndim - 1)) + ((0, 1),)
+    hi = jnp.pad(hi, pad_cfg)  # 22 columns; own top folds at its 2^264
+    hi = carry(hi, passes=4)
+    return carry(lo + hi * TOP_FOLD)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    assert 0 <= k < (1 << 17)
+    return carry(a * k)
+
+
+def _pow_const(a: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """a ** exponent via left-to-right square-and-multiply under lax.scan."""
+    bits = np.array(
+        [(exponent >> i) & 1 for i in reversed(range(exponent.bit_length()))],
+        dtype=np.int32,
+    )
+    acc = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(jnp.int32)
+
+    def body(acc, bit):
+        acc = sqr(acc)
+        acc = jnp.where(bit == 1, mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = lax.scan(body, acc, jnp.asarray(bits))
+    return acc
+
+
+def invert(a: jnp.ndarray) -> jnp.ndarray:
+    return _pow_const(a, P - 2)
+
+
+def pow_p58(a: jnp.ndarray) -> jnp.ndarray:
+    """a ** ((p-5)/8), the core of the combined sqrt/division trick."""
+    return _pow_const(a, (P - 5) // 8)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p): strict carry + cond subtracts.
+
+    Parallel passes kill all excess above the +1 ripple; one sequential
+    ripple pass (carries <= 1) makes limbs strictly < 2^12; each top fold
+    strictly decreases the value below 2^264, so a handful of passes
+    suffices for any loose input. Then subtract p up to twice.
+    """
+    for _ in range(4):
+        a = _parallel_carry_pass(a)
+    # Sequential ripple passes (carries tiny now). Each top fold strictly
+    # decreases the value below 2^264; from a loose value at most two folds
+    # can ever fire, so three passes leave all limbs strictly < 2^12.
+    for _ in range(3):
+        for i in range(NLIMBS - 1):
+            cr = a[..., i] >> RADIX
+            a = a.at[..., i].add(-(cr << RADIX))
+            a = a.at[..., i + 1].add(cr)
+        top = a[..., NLIMBS - 1] >> RADIX
+        a = a.at[..., NLIMBS - 1].add(-(top << RADIX))
+        a = a.at[..., 0].add(top * TOP_FOLD)
+
+    # The representation spans 264 bits, so the value can still be ~512*p.
+    # Fold bits >= 255 (the top 9 bits of limb 21) down: 2^255 == 19 (mod p),
+    # leaving the value < 2^255 + 512*19 < 2*p; then subtract p <= twice.
+    hi = a[..., NLIMBS - 1] >> 3
+    a = a.at[..., NLIMBS - 1].add(-(hi << 3))
+    a = a.at[..., 0].add(hi * 19)
+    for i in range(NLIMBS - 1):
+        cr = a[..., i] >> RADIX
+        a = a.at[..., i].add(-(cr << RADIX))
+        a = a.at[..., i + 1].add(cr)
+
+    p_limbs = jnp.asarray(P_LIMBS)
+    for _ in range(2):
+        diff = a - p_limbs
+        borrow = jnp.zeros(a.shape[:-1], dtype=jnp.int32)
+        out = jnp.zeros_like(a)
+        for i in range(NLIMBS):
+            d = diff[..., i] - borrow
+            borrow = (d < 0).astype(jnp.int32)
+            out = out.at[..., i].set(d + (borrow << RADIX))
+        a = jnp.where((borrow == 0)[..., None], out, a)
+    return a
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched field equality -> bool (...,)."""
+    return jnp.all(freeze(a) == freeze(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=-1)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Least significant bit of the canonical representative."""
+    return freeze(a)[..., 0] & 1
+
+
+# --- byte <-> limb conversion (static index/shift tables) -------------------
+
+# limb j covers bits [12j, 12j+12); byte k covers bits [8k, 8k+8).
+_DEC_BYTE_IDX = np.zeros((NLIMBS, 3), np.int32)
+_DEC_SHIFT = np.zeros(NLIMBS, np.int32)
+for _j in range(NLIMBS):
+    bit = RADIX * _j
+    k = bit // 8
+    _DEC_BYTE_IDX[_j] = [k, k + 1, k + 2]  # input padded to 34 bytes
+    _DEC_SHIFT[_j] = bit - 8 * k
+
+_ENC_LIMB_IDX = np.zeros((32, 2), np.int32)
+_ENC_SHIFT = np.zeros(32, np.int32)
+for _k in range(32):
+    bit = 8 * _k
+    j = bit // RADIX
+    _ENC_LIMB_IDX[_k] = [min(j, NLIMBS - 1), min(j + 1, NLIMBS - 1)]
+    _ENC_SHIFT[_k] = bit - RADIX * j
+
+
+def decode_bytes(b: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) uint8 little-endian -> (..., 22) limbs (top bit cleared)."""
+    b = b.astype(jnp.int32)
+    b = b.at[..., 31].set(b[..., 31] & 0x7F)
+    # pad two zero bytes so 3-byte windows never run off the end
+    pad_cfg = tuple((0, 0) for _ in range(b.ndim - 1)) + ((0, 2),)
+    b = jnp.pad(b, pad_cfg)
+    b0 = b[..., _DEC_BYTE_IDX[:, 0]]
+    b1 = b[..., _DEC_BYTE_IDX[:, 1]]
+    b2 = b[..., _DEC_BYTE_IDX[:, 2]]
+    sh = jnp.asarray(_DEC_SHIFT)
+    word = b0 + (b1 << 8) + (b2 << 16)
+    return (word >> sh) & MASK
+
+
+def encode_bytes(a: jnp.ndarray) -> jnp.ndarray:
+    """(..., 22) limbs -> canonical (..., 32) uint8 little-endian."""
+    a = freeze(a)
+    l0 = a[..., _ENC_LIMB_IDX[:, 0]]
+    l1 = a[..., _ENC_LIMB_IDX[:, 1]]
+    sh = jnp.asarray(_ENC_SHIFT)
+    word = (l0 >> sh) + (l1 << (RADIX - sh))
+    return (word & 0xFF).astype(jnp.uint8)

@@ -1,0 +1,212 @@
+"""The device quorum plane: dense vote tensors + psum quorum detection.
+
+TPU-native redesign of the per-message Python tallies in the reference's
+``plenum/server/consensus/ordering_service.py`` (PREPARE/COMMIT cert
+collection), ``checkpoint_service.py`` (checkpoint stabilization) and
+``plenum/server/quorums.py`` (thresholds).
+
+Instead of dict-of-sets bookkeeping, votes live in dense uint8 tensors:
+
+    prepare_votes, commit_votes : (N_validators, LOG_SIZE_slots)
+    preprepare_seen, ordered    : (LOG_SIZE_slots,)
+    checkpoint_votes            : (N_validators, n_checkpoint_slots)
+
+One jitted :func:`step` scatters a batch of validated protocol messages into
+the tensors and recomputes quorum masks with masked sums + threshold
+compares. Under ``shard_map`` the validator axis is sharded over the mesh
+("validators" axis); vote counts become ``psum`` — the ICI is the vote bus.
+Slots are watermark-relative (slot = ppSeqNo - h - 1, 0 <= slot < LOG_SIZE),
+mirroring the reference's h/H watermark window; the host runtime slides the
+window and resets slot columns on checkpoint stabilization.
+
+Quorum thresholds (reference ``plenum/server/quorums.py``): f = (n-1)//3;
+prepare quorum = n-f-1 (excludes the primary, which doesn't send PREPARE);
+commit/checkpoint quorum = n-f.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# message kinds in the packed device format
+PREPREPARE = 0
+PREPARE = 1
+COMMIT = 2
+CHECKPOINT = 3
+
+
+class VoteState(NamedTuple):
+    """Device-resident per-instance vote tensors (slots are h-relative)."""
+
+    preprepare_seen: jnp.ndarray  # (S,) uint8
+    prepare_votes: jnp.ndarray  # (N, S) uint8  (sharded over N under a mesh)
+    commit_votes: jnp.ndarray  # (N, S) uint8
+    checkpoint_votes: jnp.ndarray  # (N, C) uint8
+    ordered: jnp.ndarray  # (S,) uint8
+
+
+class MsgBatch(NamedTuple):
+    """A packed batch of validated consensus messages for the device plane."""
+
+    kind: jnp.ndarray  # (M,) int32, one of the four kinds
+    sender: jnp.ndarray  # (M,) int32 validator index
+    slot: jnp.ndarray  # (M,) int32 h-relative slot (or checkpoint slot)
+    valid: jnp.ndarray  # (M,) bool — invalid entries are padding
+
+
+class QuorumEvents(NamedTuple):
+    prepared: jnp.ndarray  # (S,) bool — prepare cert reached
+    newly_ordered: jnp.ndarray  # (S,) bool — commit cert newly reached
+    ordered: jnp.ndarray  # (S,) bool — cumulative
+    stable_checkpoints: jnp.ndarray  # (C,) bool — checkpoint quorum reached
+    prepare_counts: jnp.ndarray  # (S,) int32 (diagnostics / monitor feed)
+    commit_counts: jnp.ndarray  # (S,) int32
+
+
+def init_state(n_validators: int, log_size: int, n_checkpoints: int) -> VoteState:
+    return VoteState(
+        preprepare_seen=jnp.zeros((log_size,), jnp.uint8),
+        prepare_votes=jnp.zeros((n_validators, log_size), jnp.uint8),
+        commit_votes=jnp.zeros((n_validators, log_size), jnp.uint8),
+        checkpoint_votes=jnp.zeros((n_validators, n_checkpoints), jnp.uint8),
+        ordered=jnp.zeros((log_size,), jnp.uint8),
+    )
+
+
+def _scatter_local(
+    state: VoteState, msgs: MsgBatch, row_offset: jnp.ndarray, local_rows: int
+) -> VoteState:
+    """Scatter message batch into the local shard of the vote tensors."""
+    local = msgs.sender - row_offset
+    mine = msgs.valid & (local >= 0) & (local < local_rows)
+    lidx = jnp.clip(local, 0, local_rows - 1)
+    slot = jnp.clip(msgs.slot, 0, state.prepare_votes.shape[1] - 1)
+    cslot = jnp.clip(msgs.slot, 0, state.checkpoint_votes.shape[1] - 1)
+
+    def hits(kind):
+        return (msgs.kind == kind) & mine
+
+    pv = state.prepare_votes.at[lidx, slot].max(hits(PREPARE).astype(jnp.uint8))
+    cv = state.commit_votes.at[lidx, slot].max(hits(COMMIT).astype(jnp.uint8))
+    ck = state.checkpoint_votes.at[lidx, cslot].max(
+        hits(CHECKPOINT).astype(jnp.uint8)
+    )
+    # PRE-PREPARE is per-slot, not per-validator: replicated across shards.
+    pp_hit = (msgs.kind == PREPREPARE) & msgs.valid
+    pp = state.preprepare_seen.at[slot].max(pp_hit.astype(jnp.uint8))
+    return VoteState(pp, pv, cv, ck, state.ordered)
+
+
+def _quorum_events(
+    state: VoteState, n: int, axis_name: Optional[str]
+) -> Tuple[VoteState, QuorumEvents]:
+    f = (n - 1) // 3
+    prepare_q = n - f - 1
+    commit_q = n - f
+
+    def total(votes):  # sum over the (possibly sharded) validator axis
+        local = jnp.sum(votes.astype(jnp.int32), axis=0)
+        if axis_name is not None:
+            return lax.psum(local, axis_name)
+        return local
+
+    prep_counts = total(state.prepare_votes)
+    comm_counts = total(state.commit_votes)
+    chk_counts = total(state.checkpoint_votes)
+
+    pp = state.preprepare_seen.astype(bool)
+    prepared = pp & (prep_counts >= prepare_q)
+    commit_ok = pp & (comm_counts >= commit_q) & prepared
+    newly = commit_ok & ~state.ordered.astype(bool)
+    ordered = state.ordered.astype(bool) | commit_ok
+    stable = chk_counts >= commit_q
+    new_state = state._replace(ordered=ordered.astype(jnp.uint8))
+    return new_state, QuorumEvents(
+        prepared=prepared,
+        newly_ordered=newly,
+        ordered=ordered,
+        stable_checkpoints=stable,
+        prepare_counts=prep_counts,
+        commit_counts=comm_counts,
+    )
+
+
+def step(
+    state: VoteState, msgs: MsgBatch, n_validators: int
+) -> Tuple[VoteState, QuorumEvents]:
+    """Single-device step: scatter a message batch, recompute quorums."""
+    state = _scatter_local(
+        state, msgs, jnp.zeros((), jnp.int32), state.prepare_votes.shape[0]
+    )
+    return _quorum_events(state, n_validators, None)
+
+
+def make_sharded_step(mesh: Mesh, n_validators: int, axis: str = "validators"):
+    """Build a pjit-ed step with the validator axis sharded over ``mesh``.
+
+    The returned function takes a VoteState whose (N, S) tensors are sharded
+    P(axis, None) and a replicated MsgBatch; vote counting rides the ICI as
+    ``psum``. This is the "one pod simulates the pool" configuration from
+    BASELINE.json's north star.
+    """
+    n_shards = mesh.shape[axis]
+    assert n_validators % n_shards == 0, (n_validators, n_shards)
+    local_rows = n_validators // n_shards
+
+    def inner(state: VoteState, msgs: MsgBatch):
+        offset = lax.axis_index(axis).astype(jnp.int32) * local_rows
+        state = _scatter_local(state, msgs, offset, local_rows)
+        return _quorum_events(state, n_validators, axis)
+
+    row_sharded = VoteState(
+        preprepare_seen=P(),
+        prepare_votes=P(axis, None),
+        commit_votes=P(axis, None),
+        checkpoint_votes=P(axis, None),
+        ordered=P(),
+    )
+    replicated_msgs = MsgBatch(kind=P(), sender=P(), slot=P(), valid=P())
+    events_spec = QuorumEvents(
+        prepared=P(),
+        newly_ordered=P(),
+        ordered=P(),
+        stable_checkpoints=P(),
+        prepare_counts=P(),
+        commit_counts=P(),
+    )
+
+    shard_fn = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(row_sharded, replicated_msgs),
+        out_specs=(row_sharded, events_spec),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)
+
+
+def pack_messages(
+    entries, max_batch: int
+) -> MsgBatch:
+    """Host helper: list of (kind, sender, slot) -> padded device MsgBatch."""
+    m = len(entries)
+    assert m <= max_batch
+    kind = np.zeros(max_batch, np.int32)
+    sender = np.zeros(max_batch, np.int32)
+    slot = np.zeros(max_batch, np.int32)
+    valid = np.zeros(max_batch, bool)
+    for i, (k, s, sl) in enumerate(entries):
+        kind[i], sender[i], slot[i], valid[i] = k, s, sl, True
+    return MsgBatch(
+        kind=jnp.asarray(kind),
+        sender=jnp.asarray(sender),
+        slot=jnp.asarray(slot),
+        valid=jnp.asarray(valid),
+    )

@@ -1,0 +1,100 @@
+"""Fused device consensus step: Ed25519 batch verify -> quorum tally.
+
+This is the "flagship model forward step" of the framework: one jitted
+program that (a) verifies the pending signed-message batch on device and
+(b) scatters the surviving votes into the dense quorum tensors, returning
+only quorum events to the host. It is the TPU composition of the reference's
+``CoreAuthNr.authenticate`` hot loop with ``OrderingService``'s cert
+collection (see SURVEY.md §3.1).
+
+Sharding layout over a 1-D ``Mesh(("validators",))``:
+- signature batch axis: sharded (each validator shard verifies its slice) —
+  the data-parallel axis;
+- vote tensors: validator rows sharded — the tensor-parallel axis;
+- quorum counts: ``psum`` over the mesh; verdicts: ``all_gather``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import ed25519 as ted
+from . import quorum as q
+
+
+def fused_step(
+    state: q.VoteState,
+    msgs: q.MsgBatch,
+    pk: jnp.ndarray,
+    rb: jnp.ndarray,
+    s: jnp.ndarray,
+    h: jnp.ndarray,
+    *,
+    n_validators: int,
+) -> Tuple[q.VoteState, q.QuorumEvents, jnp.ndarray]:
+    """Single-device fused step. msgs batch length == signature batch length."""
+    ok = ted._verify_kernel(pk, rb, s, h)
+    msgs = msgs._replace(valid=msgs.valid & ok)
+    state, events = q.step(state, msgs, n_validators)
+    return state, events, ok
+
+
+def make_sharded_fused_step(
+    mesh: Mesh, n_validators: int, axis: str = "validators"
+):
+    """Sharded fused step over ``mesh``: returns a jitted callable.
+
+    Inputs: VoteState with (N, S) tensors sharded P(axis, None); MsgBatch
+    replicated; signature arrays (B, 32) sharded P(axis, None) on the batch
+    axis. B and the message batch M must be equal and divisible by the mesh
+    size.
+    """
+    n_shards = mesh.shape[axis]
+    assert n_validators % n_shards == 0
+    local_rows = n_validators // n_shards
+
+    def inner(state, msgs, pk, rb, s, h):
+        ok_local = ted._verify_kernel(pk, rb, s, h)
+        ok = lax.all_gather(ok_local, axis, tiled=True)
+        msgs = msgs._replace(valid=msgs.valid & ok)
+        offset = lax.axis_index(axis).astype(jnp.int32) * local_rows
+        state = q._scatter_local(state, msgs, offset, local_rows)
+        state, events = q._quorum_events(state, n_validators, axis)
+        return state, events, ok
+
+    row_sharded = q.VoteState(
+        preprepare_seen=P(),
+        prepare_votes=P(axis, None),
+        commit_votes=P(axis, None),
+        checkpoint_votes=P(axis, None),
+        ordered=P(),
+    )
+    replicated_msgs = q.MsgBatch(kind=P(), sender=P(), slot=P(), valid=P())
+    batch_sharded = P(axis, None)
+    events_spec = q.QuorumEvents(
+        prepared=P(),
+        newly_ordered=P(),
+        ordered=P(),
+        stable_checkpoints=P(),
+        prepare_counts=P(),
+        commit_counts=P(),
+    )
+    shard_fn = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            row_sharded,
+            replicated_msgs,
+            batch_sharded,
+            batch_sharded,
+            batch_sharded,
+            batch_sharded,
+        ),
+        out_specs=(row_sharded, events_spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn)

@@ -3,23 +3,30 @@
 Multi-chip TPU hardware is not available in CI; all sharding tests run on a
 virtual CPU mesh (the driver separately dry-run-compiles the multi-chip path
 via __graft_entry__.dryrun_multichip).
+
+NOTE: this environment registers a TPU backend from sitecustomize and forces
+``jax_platforms`` via ``axon.register`` — an env-var override is NOT enough;
+we must override the config attribute after importing jax (and before any
+backend is initialized).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
-
     devices = jax.devices()
     if len(devices) < 8:
         pytest.skip("needs 8 virtual devices")

@@ -1,0 +1,94 @@
+"""Tier-1: TPU Ed25519 batch-verify kernel vs host oracle + RFC 8032 vectors.
+
+Mirrors the reference's crypto unit tier (libsodium wrappers tested in
+``stp_core``); the oracle here is both our pure-Python RFC 8032
+implementation and OpenSSL via the ``cryptography`` package.
+"""
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from indy_plenum_tpu.crypto import ed25519 as ed  # noqa: E402
+from indy_plenum_tpu.tpu import ed25519 as ted  # noqa: E402
+
+RFC8032_VECTORS = [
+    # (secret seed, public key, message, signature) -- RFC 8032 §7.1
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        None,
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        None,
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        None,
+    ),
+]
+
+
+def test_rfc8032_vectors_device():
+    pks, msgs, sigs = [], [], []
+    for seed_hex, pk_hex, msg_hex, _ in RFC8032_VECTORS:
+        seed = bytes.fromhex(seed_hex)
+        pk = bytes.fromhex(pk_hex)
+        assert ed.public_key(seed) == pk  # host impl agrees with RFC
+        msg = bytes.fromhex(msg_hex)
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(ed.sign(seed, msg))
+    ok = ted.batch_verify(pks, msgs, sigs)
+    assert ok.all()
+
+
+def test_mixed_valid_invalid_batch():
+    rng = random.Random(42)
+    pks, msgs, sigs, expect = [], [], [], []
+    for i in range(24):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        pk = ed.fast_public_key(seed)
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64)))
+        sig = ed.fast_sign(seed, msg)
+        kind = i % 4
+        if kind == 1:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt R
+        elif kind == 2:
+            msg = msg + b"!"  # message tampered
+        elif kind == 3:
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]  # corrupt S
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sig)
+        expect.append(ed.fast_verify(pk, msg, sig))
+    got = ted.batch_verify(pks, msgs, sigs)
+    assert list(map(bool, got)) == expect
+
+
+def test_structural_rejections():
+    seed = bytes(range(32))
+    pk = ed.fast_public_key(seed)
+    msg = b"hello"
+    sig = ed.fast_sign(seed, msg)
+    # S >= L (host-side range check)
+    bad_s = sig[:32] + (ed.L).to_bytes(32, "little")
+    # truncated pk, truncated sig
+    got = ted.batch_verify([pk, pk[:31], pk], [msg, msg, msg], [bad_s, sig, sig[:63]])
+    assert list(map(bool, got)) == [False, False, False]
+    # non-canonical pk encoding (y >= p) must be rejected
+    noncanon = (ed.P + 1).to_bytes(32, "little")
+    got = ted.batch_verify([noncanon], [msg], [sig])
+    assert not got[0]
+
+
+def test_empty_batch():
+    assert ted.batch_verify([], [], []).shape == (0,)

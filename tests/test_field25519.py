@@ -1,0 +1,126 @@
+"""Tier-1 unit tests: GF(2^255-19) limb arithmetic vs Python big ints.
+
+Mirrors the reference's pure-unit tier (SURVEY.md §4 tier 1); the oracle is
+Python's arbitrary-precision integers.
+"""
+import random
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from indy_plenum_tpu.tpu import field25519 as fe  # noqa: E402
+
+rng = random.Random(0xED25519)
+
+
+def rand_int():
+    return rng.randrange(0, fe.P)
+
+
+ADVERSARIAL = [
+    0,
+    1,
+    2,
+    19,
+    fe.P - 1,
+    fe.P - 2,
+    (1 << 255) - 1,
+    (1 << 256) - 1,
+    fe.P,
+    fe.P + 1,
+    2 * fe.P - 1,
+    (1 << 263) + 12345,
+    (1 << 264) - 1,
+    511 * fe.P + 7,
+]
+
+
+def batch(ints):
+    full = 1 << (fe.RADIX * fe.NLIMBS)
+    return jnp.asarray(np.stack([fe.limbs_from_int(x % full) for x in ints]))
+
+
+def loose_batch(ints):
+    """Adversarial loose limbs: value encoded with limbs up to 2^17-1."""
+    out = []
+    for x in ints:
+        limbs = np.zeros(fe.NLIMBS, dtype=np.int64)
+        rem = x
+        for i in range(fe.NLIMBS):
+            limbs[i] = rem & fe.MASK
+            rem >>= fe.RADIX
+        # push random slack between adjacent limbs: limb_i += 2^16, limb_{i+1} -= 1
+        for i in range(fe.NLIMBS - 1):
+            if rng.random() < 0.5 and limbs[i + 1] > 0:
+                limbs[i] += 1 << fe.RADIX
+                limbs[i + 1] -= 1
+        out.append(limbs)
+    return jnp.asarray(np.stack(out))
+
+
+def as_ints(limbs):
+    arr = np.asarray(limbs)
+    return [fe.int_from_limbs(arr[i]) for i in range(arr.shape[0])]
+
+
+def test_roundtrip():
+    xs = [rand_int() for _ in range(64)] + ADVERSARIAL
+    got = as_ints(batch(xs))
+    assert got == [x % fe.P for x in xs]
+
+
+def test_add_sub_mul():
+    xs = [rand_int() for _ in range(128)] + ADVERSARIAL
+    ys = [rand_int() for _ in range(128)] + list(reversed(ADVERSARIAL))
+    a, b = batch(xs), batch(ys)
+    assert as_ints(fe.add(a, b)) == [(x + y) % fe.P for x, y in zip(xs, ys)]
+    assert as_ints(fe.sub(a, b)) == [(x - y) % fe.P for x, y in zip(xs, ys)]
+    assert as_ints(fe.mul(a, b)) == [(x * y) % fe.P for x, y in zip(xs, ys)]
+    assert as_ints(fe.sqr(a)) == [(x * x) % fe.P for x in xs]
+    assert as_ints(fe.neg(a)) == [(-x) % fe.P for x in xs]
+
+
+def test_loose_inputs():
+    xs = [rand_int() for _ in range(64)]
+    ys = [rand_int() for _ in range(64)]
+    a, b = loose_batch(xs), loose_batch(ys)
+    assert as_ints(fe.mul(a, b)) == [(x * y) % fe.P for x, y in zip(xs, ys)]
+    assert as_ints(fe.add(a, b)) == [(x + y) % fe.P for x, y in zip(xs, ys)]
+
+
+def test_freeze_canonical():
+    xs = [rand_int() for _ in range(32)] + ADVERSARIAL
+    a = fe.freeze(loose_batch(xs))
+    arr = np.asarray(a)
+    assert arr.min() >= 0
+    assert arr.max() < (1 << fe.RADIX)
+    assert as_ints(a) == [x % fe.P for x in xs]
+    # canonical: value below p when re-read without mod
+    for i in range(arr.shape[0]):
+        raw = sum(int(arr[i, j]) << (fe.RADIX * j) for j in range(fe.NLIMBS))
+        assert raw < fe.P
+
+
+def test_invert_and_sqrt_core():
+    xs = [rand_int() for x in range(8) if True]
+    xs = [x if x != 0 else 1 for x in xs]
+    a = batch(xs)
+    inv = fe.invert(a)
+    assert as_ints(inv) == [pow(x, fe.P - 2, fe.P) for x in xs]
+    p58 = fe.pow_p58(a)
+    assert as_ints(p58) == [pow(x, (fe.P - 5) // 8, fe.P) for x in xs]
+
+
+def test_eq_parity_encode():
+    xs = [rand_int() for _ in range(16)]
+    a = batch(xs)
+    b = batch([x + fe.P for x in xs])  # same values mod p, different encoding
+    assert bool(jnp.all(fe.eq(a, b)))
+    assert [int(v) for v in fe.parity(a)] == [x % 2 for x in xs]
+    enc = np.asarray(fe.encode_bytes(a))
+    for i, x in enumerate(xs):
+        assert enc[i].tobytes() == (x % fe.P).to_bytes(32, "little")
+    dec = fe.decode_bytes(jnp.asarray(enc))
+    assert as_ints(dec) == [x % fe.P for x in xs]

@@ -1,0 +1,91 @@
+"""Tier-1/5: device quorum plane vs a numpy oracle; sharded == unsharded.
+
+The sharded variant runs on the 8-device virtual CPU mesh (conftest), the
+same code path the driver's dryrun_multichip exercises.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from indy_plenum_tpu.tpu import quorum as q  # noqa: E402
+
+N = 16
+S = 32  # log slots
+C = 4  # checkpoint slots
+F = (N - 1) // 3
+
+
+def np_oracle(entries):
+    pp = np.zeros(S, bool)
+    pv = np.zeros((N, S), bool)
+    cv = np.zeros((N, S), bool)
+    ck = np.zeros((N, C), bool)
+    for k, s, sl in entries:
+        if k == q.PREPREPARE:
+            pp[sl] = True
+        elif k == q.PREPARE:
+            pv[s, sl] = True
+        elif k == q.COMMIT:
+            cv[s, sl] = True
+        elif k == q.CHECKPOINT:
+            ck[s, sl] = True
+    prepared = pp & (pv.sum(0) >= N - F - 1)
+    ordered = prepared & (cv.sum(0) >= N - F)
+    stable = ck.sum(0) >= N - F
+    return prepared, ordered, stable
+
+
+def random_entries(rng, m):
+    out = []
+    for _ in range(m):
+        k = rng.choice([q.PREPREPARE, q.PREPARE, q.COMMIT, q.CHECKPOINT])
+        s = rng.randint(0, N)
+        sl = rng.randint(0, S if k != q.CHECKPOINT else C)
+        out.append((int(k), int(s), int(sl)))
+    return out
+
+
+def test_step_matches_oracle():
+    rng = np.random.RandomState(0)
+    entries = random_entries(rng, 400)
+    state = q.init_state(N, S, C)
+    msgs = q.pack_messages(entries, 512)
+    state, ev = q.step(state, msgs, N)
+    prepared, ordered, stable = np_oracle(entries)
+    assert np.array_equal(np.asarray(ev.prepared), prepared)
+    assert np.array_equal(np.asarray(ev.ordered), ordered)
+    assert np.array_equal(np.asarray(ev.newly_ordered), ordered)
+    assert np.array_equal(np.asarray(ev.stable_checkpoints), stable)
+
+
+def test_incremental_newly_ordered():
+    # Drive one slot to commit quorum across two steps; newly_ordered fires once.
+    state = q.init_state(N, S, C)
+    first = [(q.PREPREPARE, 0, 5)] + [(q.PREPARE, v, 5) for v in range(1, N)]
+    state, ev = q.step(state, q.pack_messages(first, 64), N)
+    assert bool(ev.prepared[5]) and not bool(ev.ordered[5])
+    second = [(q.COMMIT, v, 5) for v in range(N - F)]
+    state, ev = q.step(state, q.pack_messages(second, 64), N)
+    assert bool(ev.newly_ordered[5])
+    # a third step with more commits must NOT re-fire newly_ordered
+    third = [(q.COMMIT, v, 5) for v in range(N)]
+    state, ev = q.step(state, q.pack_messages(third, 64), N)
+    assert bool(ev.ordered[5]) and not bool(ev.newly_ordered[5])
+
+
+def test_sharded_step_matches_unsharded(eight_devices):
+    mesh = Mesh(np.array(eight_devices), ("validators",))
+    sharded = q.make_sharded_step(mesh, N)
+    rng = np.random.RandomState(1)
+    entries = random_entries(rng, 300)
+    msgs = q.pack_messages(entries, 512)
+
+    ref_state, ref_ev = q.step(q.init_state(N, S, C), msgs, N)
+    state, ev = sharded(q.init_state(N, S, C), msgs)
+    for a, b in zip(ev, ref_ev):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(state, ref_state):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

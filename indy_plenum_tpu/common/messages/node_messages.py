@@ -1,0 +1,317 @@
+"""Every inter-node wire message as a typed, validated class.
+
+Reference: plenum/common/messages/node_messages.py (PrePrepare, Prepare,
+Commit, Checkpoint, ViewChange, NewView, InstanceChange, Propagate,
+LedgerStatus, ConsistencyProof, CatchupReq, CatchupRep, MessageReq,
+MessageRep, Ordered, Batch). Field names follow
+:class:`indy_plenum_tpu.common.constants.f`.
+
+BatchID convention (reference plenum/server/consensus/batch_id.py): a 4-list
+``[view_no, pp_view_no, pp_seq_no, pp_digest]`` — ``pp_view_no`` is the view
+the batch's PRE-PREPARE was originally created in (survives re-ordering
+across view changes), ``view_no`` the view it is being ordered in.
+"""
+from __future__ import annotations
+
+from .fields import (
+    AnyField,
+    Base58Field,
+    BooleanField,
+    EnumField,
+    FixedLengthTupleField,
+    IntegerField,
+    IterableField,
+    LedgerIdField,
+    LimitedLengthStringField,
+    MapField,
+    MerkleRootField,
+    NonEmptyStringField,
+    NonNegativeNumberField,
+    ProtocolVersionField,
+    SerializedValueField,
+    SignatureField,
+    TimestampField,
+)
+from .message_base import MessageBase, node_message_registry
+
+_DIGEST = LimitedLengthStringField(max_length=512)
+_SENDER = LimitedLengthStringField(max_length=256)
+
+BATCH_ID_FIELD = FixedLengthTupleField((
+    NonNegativeNumberField(),  # view_no
+    NonNegativeNumberField(),  # pp_view_no
+    NonNegativeNumberField(),  # pp_seq_no
+    LimitedLengthStringField(max_length=512),  # pp_digest
+))
+
+CHECKPOINT_VALUE_FIELD = FixedLengthTupleField((
+    NonNegativeNumberField(),  # view_no
+    NonNegativeNumberField(),  # pp_seq_no
+    LimitedLengthStringField(max_length=512),  # digest
+))
+
+
+def register(cls):
+    return node_message_registry.register(cls)
+
+
+@register
+class Propagate(MessageBase):
+    typename = "PROPAGATE"
+    schema = (
+        ("request", AnyField()),  # full client request dict
+        ("senderClient", LimitedLengthStringField(max_length=256,
+                                                  nullable=True)),
+    )
+
+
+@register
+class PrePrepare(MessageBase):
+    typename = "PREPREPARE"
+    schema = (
+        ("instId", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField()),
+        ("ppSeqNo", NonNegativeNumberField()),
+        ("ppTime", TimestampField()),
+        ("reqIdr", IterableField(_DIGEST)),  # ordered request digests
+        ("discarded", NonNegativeNumberField()),
+        ("digest", _DIGEST),
+        ("ledgerId", LedgerIdField()),
+        ("stateRootHash", MerkleRootField(nullable=True)),
+        ("txnRootHash", MerkleRootField(nullable=True)),
+        ("sub_seq_no", NonNegativeNumberField()),
+        ("final", BooleanField()),
+        ("poolStateRootHash", MerkleRootField(nullable=True, optional=True)),
+        ("auditTxnRootHash", MerkleRootField(nullable=True, optional=True)),
+        ("blsMultiSig", AnyField(optional=True, nullable=True)),
+        ("originalViewNo", NonNegativeNumberField(optional=True,
+                                                  nullable=True)),
+    )
+
+
+@register
+class Prepare(MessageBase):
+    typename = "PREPARE"
+    schema = (
+        ("instId", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField()),
+        ("ppSeqNo", NonNegativeNumberField()),
+        ("ppTime", TimestampField()),
+        ("digest", _DIGEST),
+        ("stateRootHash", MerkleRootField(nullable=True)),
+        ("txnRootHash", MerkleRootField(nullable=True)),
+        ("auditTxnRootHash", MerkleRootField(nullable=True, optional=True)),
+    )
+
+
+@register
+class Commit(MessageBase):
+    typename = "COMMIT"
+    schema = (
+        ("instId", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField()),
+        ("ppSeqNo", NonNegativeNumberField()),
+        ("blsSig", LimitedLengthStringField(max_length=512, optional=True,
+                                            nullable=True)),
+        ("blsSigs", MapField(NonEmptyStringField(),
+                             LimitedLengthStringField(max_length=512),
+                             optional=True, nullable=True)),
+    )
+
+
+@register
+class Checkpoint(MessageBase):
+    typename = "CHECKPOINT"
+    schema = (
+        ("instId", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField()),
+        ("seqNoStart", NonNegativeNumberField()),
+        ("seqNoEnd", NonNegativeNumberField()),
+        ("digest", _DIGEST),
+    )
+
+
+@register
+class InstanceChange(MessageBase):
+    typename = "INSTANCE_CHANGE"
+    schema = (
+        ("viewNo", NonNegativeNumberField()),
+        ("reason", IntegerField()),  # suspicion code
+    )
+
+
+@register
+class ViewChange(MessageBase):
+    typename = "VIEW_CHANGE"
+    schema = (
+        ("viewNo", NonNegativeNumberField()),
+        ("stableCheckpoint", NonNegativeNumberField()),
+        ("prepared", IterableField(BATCH_ID_FIELD)),
+        ("preprepared", IterableField(BATCH_ID_FIELD)),
+        ("checkpoints", IterableField(CHECKPOINT_VALUE_FIELD)),
+    )
+
+
+@register
+class ViewChangeAck(MessageBase):
+    typename = "VIEW_CHANGE_ACK"
+    schema = (
+        ("viewNo", NonNegativeNumberField()),
+        ("name", _SENDER),  # whose VIEW_CHANGE is being acked
+        ("digest", _DIGEST),
+    )
+
+
+@register
+class NewView(MessageBase):
+    typename = "NEW_VIEW"
+    schema = (
+        ("viewNo", NonNegativeNumberField()),
+        # [(sender, view_change_digest)] the primary built the view from
+        ("viewChanges", IterableField(FixedLengthTupleField(
+            (_SENDER, _DIGEST)))),
+        ("checkpoint", CHECKPOINT_VALUE_FIELD),
+        ("batches", IterableField(BATCH_ID_FIELD)),
+        ("primary", _SENDER),
+    )
+
+
+@register
+class Ordered(MessageBase):
+    typename = "ORDERED"
+    schema = (
+        ("instId", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField()),
+        ("ppSeqNo", NonNegativeNumberField()),
+        ("ppTime", TimestampField()),
+        ("reqIdr", IterableField(_DIGEST)),
+        ("discarded", NonNegativeNumberField()),
+        ("ledgerId", LedgerIdField()),
+        ("stateRootHash", MerkleRootField(nullable=True)),
+        ("txnRootHash", MerkleRootField(nullable=True)),
+        ("auditTxnRootHash", MerkleRootField(nullable=True, optional=True)),
+        ("primaries", IterableField(_SENDER, optional=True, nullable=True)),
+        ("originalViewNo", NonNegativeNumberField(optional=True,
+                                                  nullable=True)),
+        ("digest", _DIGEST.__class__(max_length=512, optional=True,
+                                     nullable=True)),
+    )
+
+
+@register
+class LedgerStatus(MessageBase):
+    typename = "LEDGER_STATUS"
+    schema = (
+        ("ledgerId", LedgerIdField()),
+        ("txnSeqNo", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField(nullable=True)),
+        ("ppSeqNo", NonNegativeNumberField(nullable=True)),
+        ("merkleRoot", MerkleRootField()),
+        ("protocolVersion", ProtocolVersionField()),
+    )
+
+
+@register
+class ConsistencyProof(MessageBase):
+    typename = "CONSISTENCY_PROOF"
+    schema = (
+        ("ledgerId", LedgerIdField()),
+        ("seqNoStart", NonNegativeNumberField()),
+        ("seqNoEnd", NonNegativeNumberField()),
+        ("viewNo", NonNegativeNumberField(nullable=True)),
+        ("ppSeqNo", NonNegativeNumberField(nullable=True)),
+        ("oldMerkleRoot", MerkleRootField()),
+        ("newMerkleRoot", MerkleRootField()),
+        ("hashes", IterableField(NonEmptyStringField())),
+    )
+
+
+@register
+class CatchupReq(MessageBase):
+    typename = "CATCHUP_REQ"
+    schema = (
+        ("ledgerId", LedgerIdField()),
+        ("seqNoStart", NonNegativeNumberField()),
+        ("seqNoEnd", NonNegativeNumberField()),
+        ("catchupTill", NonNegativeNumberField()),
+    )
+
+
+@register
+class CatchupRep(MessageBase):
+    typename = "CATCHUP_REP"
+    schema = (
+        ("ledgerId", LedgerIdField()),
+        # seqNo(str, msgpack keys) -> txn
+        ("txns", MapField(NonEmptyStringField(), AnyField())),
+        ("consProof", IterableField(NonEmptyStringField())),
+    )
+
+
+@register
+class MessageReq(MessageBase):
+    typename = "MESSAGE_REQUEST"
+    schema = (
+        ("msg_type", NonEmptyStringField()),
+        ("params", MapField(NonEmptyStringField(), AnyField())),
+    )
+
+
+@register
+class MessageRep(MessageBase):
+    typename = "MESSAGE_RESPONSE"
+    schema = (
+        ("msg_type", NonEmptyStringField()),
+        ("params", MapField(NonEmptyStringField(), AnyField())),
+        ("msg", AnyField(nullable=True)),
+    )
+
+
+@register
+class Batch(MessageBase):
+    """Transport-level envelope coalescing several messages to one remote.
+
+    Reference: plenum/common/batched.py -- outgoing messages per event-loop
+    flush are packed into one signed Batch.
+    """
+
+    typename = "BATCH"
+    schema = (
+        ("messages", IterableField(SerializedValueField())),
+        ("signature", SignatureField(nullable=True)),
+    )
+
+
+@register
+class BlsMultiSigMsg(MessageBase):
+    """Carrier for a BLS multi-signature value (attached to PRE-PREPAREs)."""
+
+    typename = "BLS_MULTI_SIG"
+    schema = (
+        ("signature", NonEmptyStringField()),
+        ("participants", IterableField(_SENDER)),
+        ("value", AnyField()),  # MultiSignatureValue dict
+    )
+
+
+# --- BatchID helpers -------------------------------------------------------
+
+def batch_id(view_no: int, pp_view_no: int, pp_seq_no: int,
+             pp_digest: str) -> list:
+    return [view_no, pp_view_no, pp_seq_no, pp_digest]
+
+
+def bid_view(b) -> int:
+    return b[0]
+
+
+def bid_pp_view(b) -> int:
+    return b[1]
+
+
+def bid_seq(b) -> int:
+    return b[2]
+
+
+def bid_digest(b) -> str:
+    return b[3]

@@ -1,0 +1,87 @@
+"""Canonical serialization: every node must hash/sign identical bytes.
+
+Reference: common/serializers/serialization.py (signing serializer = ordered
+msgpack; base58 root serializers; JSON txn serializer). The signing
+serialization here is msgpack with recursively key-sorted maps — canonical
+and language-independent; `None` values are dropped (absent field == None,
+as the reference's signing serializer does).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import msgpack
+
+from ...utils.base58 import b58encode, b58decode
+
+
+def _canonical(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _canonical(v) for k, v in sorted(obj.items())
+                if v is not None}
+    if isinstance(obj, (list, tuple)):
+        return [_canonical(v) for v in obj]
+    return obj
+
+
+def serialize_for_signing(obj: Any) -> bytes:
+    """Deterministic bytes for signing/digesting (ordered msgpack)."""
+    return msgpack.packb(_canonical(obj), use_bin_type=True)
+
+
+def deserialize_msgpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+def serialize_msg(obj: Any) -> bytes:
+    """Wire serialization for node/client messages (msgpack, order kept)."""
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+class JsonSerializer:
+    """Ledger txn serializer: compact, key-sorted JSON (stable digests)."""
+
+    @staticmethod
+    def dumps(obj: Any) -> bytes:
+        return json.dumps(obj, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    @staticmethod
+    def loads(data: bytes | str) -> Any:
+        if isinstance(data, (bytes, bytearray)):
+            data = data.decode()
+        return json.loads(data)
+
+
+ledger_txn_serializer = JsonSerializer()
+
+
+class Base58Serializer:
+    """Root-hash serializer: 32-byte roots <-> base58 text."""
+
+    @staticmethod
+    def serialize(raw: bytes) -> str:
+        return b58encode(raw)
+
+    @staticmethod
+    def deserialize(txt: str) -> bytes:
+        return b58decode(txt)
+
+
+state_roots_serializer = Base58Serializer()
+
+
+class ProofNodesSerializer:
+    """State-proof node list <-> msgpack bytes (client-verifiable)."""
+
+    @staticmethod
+    def serialize(nodes: Any) -> bytes:
+        return msgpack.packb(nodes, use_bin_type=True)
+
+    @staticmethod
+    def deserialize(data: bytes) -> Any:
+        return msgpack.unpackb(data, raw=False)
+
+
+proof_nodes_serializer = ProofNodesSerializer()

@@ -1,0 +1,116 @@
+"""Configuration: every protocol tunable in one overridable namespace.
+
+Reference: plenum/config.py (module-as-schema, ~200 attrs) with the overlay
+chain from plenum/common/config_util.py (``getConfig``: package defaults ->
+general config file -> network-specific -> user overrides). Here the schema
+is a dataclass; overlays are dicts (loaded from JSON files or passed
+directly), applied in order.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass
+class Config:
+    # --- 3PC batching (reference: Max3PCBatchSize / Max3PCBatchWait) ------
+    Max3PCBatchSize: int = 100
+    Max3PCBatchWait: float = 0.25  # seconds
+    Max3PCBatchesInFlight: int = 4
+
+    # --- watermarks / checkpointing (LOG_SIZE, CHK_FREQ) ------------------
+    CHK_FREQ: int = 100
+    LOG_SIZE: int = 300  # = H - h window
+    STABLE_CHECKPOINTS_KEPT: int = 1
+
+    # --- RBFT monitor thresholds (Delta / Lambda / Omega) -----------------
+    DELTA: float = 0.4  # min master/backup throughput ratio
+    LAMBDA: float = 240.0  # max master latency excess (s)
+    OMEGA: float = 20.0  # max avg latency gap master vs backups (s)
+    ThroughputWindowSize: int = 15
+    ThroughputMinCnt: int = 16
+    LatencyWindowSize: int = 15
+
+    # --- view change ------------------------------------------------------
+    ToleratePrimaryDisconnection: float = 2.0  # seconds
+    NewViewTimeout: float = 30.0  # restart VC with v+1 if not completed
+    ViewChangeResendInterval: float = 10.0
+    INSTANCE_CHANGE_TIMEOUT: float = 300.0  # discard stale instance changes
+
+    # --- catchup ----------------------------------------------------------
+    CatchupTransactionsTimeout: float = 6.0
+    ConsistencyProofsTimeout: float = 5.0
+    CatchupBatchSize: int = 5000  # txns per CATCHUP_REQ slice
+
+    # --- propagation ------------------------------------------------------
+    PROPAGATE_PHASE_DONE_TIMEOUT: float = 30.0
+    PropagateBatchSize: int = 100
+    PropagateBatchWait: float = 0.1
+
+    # --- transport --------------------------------------------------------
+    OUTGOING_BATCH_SIZE: int = 100
+    OUTGOING_BATCH_WAIT: float = 0.01
+    RETRY_TIMEOUT_NOT_RESTRICTED: float = 6.0
+    KEEPALIVE_INTERVAL: float = 1.0
+    MAX_RECONNECT_RETRY_ON_SAME_SOCKET: int = 1
+    ZMQ_CLIENT_QUEUE_SIZE: int = 0  # 0 = unbounded
+    MSG_LEN_LIMIT: int = 128 * 1024
+
+    # --- device plane (TPU) ----------------------------------------------
+    VerifyBatchSize: int = 4096  # signatures per device dispatch
+    VerifyBatchWait: float = 0.005
+    DeviceMeshAxis: str = "validators"
+    SimValidatorsPerDevice: int = 8
+
+    # --- storage ----------------------------------------------------------
+    KVStorageType: str = "sqlite"  # sqlite | memory
+    LedgerStorageType: str = "chunked_file"
+    HashStoreType: str = "kv"
+
+    # --- request handling -------------------------------------------------
+    ReplyCacheSize: int = 10000
+    ProcessedBatchMapsToKeep: int = 100
+
+    # --- metrics / observability -----------------------------------------
+    METRICS_COLLECTOR_TYPE: Optional[str] = "kv"
+    METRICS_FLUSH_INTERVAL: float = 10.0
+    RECORDER_ENABLED: bool = False
+
+    # --- misc -------------------------------------------------------------
+    NETWORK_NAME: str = "sandbox"
+    replicas_count_overrider: Optional[int] = None  # else f+1
+
+    def replicas_count(self, n_nodes: int) -> int:
+        if self.replicas_count_overrider is not None:
+            return self.replicas_count_overrider
+        f_val = (n_nodes - 1) // 3
+        return f_val + 1
+
+    def overlay(self, overrides: Dict[str, Any]) -> "Config":
+        unknown = set(overrides) - {fld.name for fld in dataclasses.fields(self)}
+        if unknown:
+            raise KeyError(f"unknown config keys: {sorted(unknown)}")
+        return dataclasses.replace(self, **overrides)
+
+
+_DEFAULT: Optional[Config] = None
+
+
+def getConfig(overrides: Optional[Dict[str, Any]] = None,
+              config_files: Tuple[str, ...] = ()) -> Config:
+    """Overlay chain: defaults -> each JSON file in order -> overrides."""
+    global _DEFAULT
+    cfg = Config()
+    for path in config_files:
+        if os.path.exists(path):
+            with open(path) as fh:
+                cfg = cfg.overlay(json.load(fh))
+    if overrides:
+        cfg = cfg.overlay(overrides)
+    if _DEFAULT is None and not overrides and not config_files:
+        _DEFAULT = cfg
+    return cfg

@@ -1,0 +1,595 @@
+"""The 3PC ordering engine: PRE-PREPARE / PREPARE / COMMIT.
+
+Reference: plenum/server/consensus/ordering_service.py (`OrderingService`).
+Host-side protocol state machine; the bulk math it used to do per-message
+(signature checks, vote counting at scale) lives in the device plane
+(:mod:`indy_plenum_tpu.tpu.ed25519`, :mod:`indy_plenum_tpu.tpu.quorum`) —
+this service handles the per-batch protocol logic: speculative execution,
+root comparison, certificates, in-order delivery, view-change revert and
+re-ordering.
+
+Roles:
+- primary: batches finalised requests (Max3PCBatchSize / Max3PCBatchWait),
+  applies them speculatively via the executor seam, emits PRE-PREPARE with
+  the uncommitted state/txn roots every replica must reproduce;
+- non-primary: re-applies the batch, compares roots (byzantine check),
+  sends PREPARE; on prepare quorum sends COMMIT (BLS-signed via the bls
+  seam); on commit quorum orders IN SEQUENCE and emits ``Ordered`` on the
+  internal bus (the node executes/commits);
+- on ViewChangeStarted: reverts uncommitted batches; on
+  NewViewCheckpointsApplied: re-orders the selected batches in the new view.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...common.event_bus import ExternalBus, InternalBus
+from ...common.exceptions import SuspiciousNode
+from ...common.messages.internal_messages import (
+    CheckpointStabilized,
+    NewViewCheckpointsApplied,
+    RaisedSuspicion,
+    RequestPropagates,
+    ViewChangeStarted,
+)
+from ...common.messages.node_messages import (
+    Commit,
+    Ordered,
+    PrePrepare,
+    Prepare,
+)
+from ...common.request import Request
+from ...common.stashing_router import (
+    DISCARD,
+    PROCESS,
+    STASH_CATCH_UP,
+    STASH_VIEW_3PC,
+    STASH_WAITING_NEW_VIEW,
+    STASH_WATERMARKS,
+    StashingRouter,
+)
+from ...common.timer import RepeatingTimer, TimerService
+from ...common.constants import DOMAIN_LEDGER_ID
+from ..suspicion_codes import Suspicions
+from .consensus_shared_data import (
+    BatchID,
+    ConsensusSharedData,
+    preprepare_to_batch_id,
+)
+
+logger = logging.getLogger(__name__)
+
+STASH_WAITING_REQUESTS = 6
+STASH_WAITING_PREV_PP = 7
+
+
+class NoOpBlsBftReplica:
+    """BLS protocol seam; the real implementation is in
+    indy_plenum_tpu.bls.bls_bft_replica (reference: plenum/bls/)."""
+
+    def update_pre_prepare(self, params: dict, ledger_id) -> dict:
+        return params
+
+    def validate_pre_prepare(self, pp, sender) -> None:
+        pass
+
+    def process_pre_prepare(self, pp, sender) -> None:
+        pass
+
+    def process_prepare(self, prepare, sender) -> None:
+        pass
+
+    def update_commit(self, params: dict, pp) -> dict:
+        return params
+
+    def validate_commit(self, commit, sender, pp) -> None:
+        pass
+
+    def process_commit(self, commit, sender) -> None:
+        pass
+
+    def process_order(self, key, quorums, pp) -> None:
+        pass
+
+    def gc(self, key_3pc) -> None:
+        pass
+
+
+class Executor:
+    """Execution seam (reference: WriteRequestManager + ledgers).
+
+    ``apply_batch`` speculatively applies finalised requests and returns the
+    resulting (state_root_b58, txn_root_b58) uncommitted roots. For a
+    ``pp_seq_no`` at or below the already-committed height it must NOT
+    re-apply — it returns the historical roots (the audit ledger knows them);
+    this is what makes post-view-change re-ordering of batches some nodes
+    already executed safe. ``revert_batches`` undoes up to ``count``
+    uncommitted batches (LIFO). The master instance executes; backups pass
+    and receive None roots.
+    """
+
+    def apply_batch(self, reqs: List[Request], ledger_id: int,
+                    pp_time: int, pp_seq_no: int
+                    ) -> Tuple[Optional[str], Optional[str]]:
+        raise NotImplementedError
+
+    def revert_batches(self, ledger_id: int, count: int) -> None:
+        raise NotImplementedError
+
+    def committed_seq(self) -> int:
+        """Highest pp_seq_no whose batch is durably committed."""
+        raise NotImplementedError
+
+
+class RequestsPool:
+    """Finalised-request source (reference: propagator's Requests container)."""
+
+    def pop_ready(self, ledger_id: int, max_count: int) -> List[Request]:
+        raise NotImplementedError
+
+    def get(self, digest: str) -> Optional[Request]:
+        raise NotImplementedError
+
+    def has_ready(self, ledger_id: int) -> bool:
+        raise NotImplementedError
+
+    def ledger_ids_with_ready(self) -> List[int]:
+        raise NotImplementedError
+
+
+class OrderingService:
+    def __init__(self,
+                 data: ConsensusSharedData,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 network: ExternalBus,
+                 stasher: StashingRouter,
+                 executor: Optional[Executor] = None,
+                 requests: Optional[RequestsPool] = None,
+                 bls=None,
+                 config=None,
+                 get_time=None):
+        from ...config import getConfig
+
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._stasher = stasher
+        self._executor = executor
+        self._requests = requests
+        self._bls = bls or NoOpBlsBftReplica()
+        self._config = config or getConfig()
+        self._get_time = get_time or timer.get_current_time
+
+        # 3PC logs, keyed (view_no, pp_seq_no)
+        self.sent_preprepares: Dict[Tuple[int, int], PrePrepare] = {}
+        self.prePrepares: Dict[Tuple[int, int], PrePrepare] = {}
+        self.prepares: Dict[Tuple[int, int], Dict[str, Prepare]] = {}
+        self.commits: Dict[Tuple[int, int], Dict[str, Commit]] = {}
+        self.ordered: set = set()
+        self.batches: Dict[Tuple[int, int], int] = {}  # key -> ledger_id
+        self.requested_pre_prepares: set = set()
+        # PrePrepares retained across a view change for re-ordering
+        self.old_view_preprepares: Dict[Tuple[int, int, str], PrePrepare] = {}
+        # highest seq speculatively applied (or committed) — the in-order
+        # apply guard for non-primary re-application
+        self._last_applied_seq = 0
+
+        stasher.subscribe(PrePrepare, self.process_preprepare)
+        stasher.subscribe(Prepare, self.process_prepare)
+        stasher.subscribe(Commit, self.process_commit)
+        bus.subscribe(ViewChangeStarted, self.process_view_change_started)
+        bus.subscribe(NewViewCheckpointsApplied,
+                      self.process_new_view_checkpoints_applied)
+        bus.subscribe(CheckpointStabilized, self.process_checkpoint_stabilized)
+
+        self._batch_timer = RepeatingTimer(
+            timer, self._config.Max3PCBatchWait, self._on_batch_timer,
+            active=False)
+
+    # ------------------------------------------------------------------
+    # primary: batch creation
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._batch_timer.start()
+
+    def stop(self) -> None:
+        self._batch_timer.stop()
+
+    @property
+    def name(self) -> str:
+        return self._data.name
+
+    @property
+    def _is_master(self) -> bool:
+        return self._data.is_master
+
+    def _can_send_batch(self) -> bool:
+        return (self._data.is_primary_in_view
+                and self._data.is_participating
+                and not self._data.waiting_for_new_view
+                and self._data.pp_seq_no < self._data.high_watermark)
+
+    def _on_batch_timer(self) -> None:
+        if not self._can_send_batch() or self._requests is None:
+            return
+        for ledger_id in self._requests.ledger_ids_with_ready():
+            if not self._can_send_batch():
+                break
+            self.send_3pc_batch(ledger_id)
+
+    def send_3pc_batch(self, ledger_id: int = DOMAIN_LEDGER_ID
+                       ) -> Optional[PrePrepare]:
+        """Primary: pop finalised requests, apply, emit PRE-PREPARE."""
+        if not self._can_send_batch() or self._requests is None:
+            return None
+        reqs = self._requests.pop_ready(
+            ledger_id, self._config.Max3PCBatchSize)
+        if not reqs:
+            return None
+        pp_time = int(self._get_time())
+        self._data.pp_seq_no += 1
+        state_root = txn_root = None
+        if self._is_master and self._executor is not None:
+            state_root, txn_root = self._executor.apply_batch(
+                reqs, ledger_id, pp_time, self._data.pp_seq_no)
+            self._last_applied_seq = max(self._last_applied_seq,
+                                         self._data.pp_seq_no)
+        params = dict(
+            instId=self._data.inst_id,
+            viewNo=self._data.view_no,
+            ppSeqNo=self._data.pp_seq_no,
+            ppTime=pp_time,
+            reqIdr=[r.digest for r in reqs],
+            discarded=0,
+            digest=self._batch_digest([r.digest for r in reqs]),
+            ledgerId=ledger_id,
+            stateRootHash=state_root,
+            txnRootHash=txn_root,
+            sub_seq_no=0,
+            final=True,
+        )
+        params = self._bls.update_pre_prepare(params, ledger_id)
+        pp = PrePrepare(**params)
+        key = (pp.viewNo, pp.ppSeqNo)
+        self.sent_preprepares[key] = pp
+        self.prePrepares[key] = pp
+        self.batches[key] = ledger_id
+        self._data.preprepare_batch(preprepare_to_batch_id(pp))
+        self._network.send(pp)
+        logger.debug("%s sent PRE-PREPARE %s (%d reqs)", self.name, key,
+                     len(reqs))
+        return pp
+
+    @staticmethod
+    def _batch_digest(req_digests: List[str]) -> str:
+        import hashlib
+
+        payload = "".join(req_digests).encode()
+        return hashlib.sha256(payload).hexdigest()
+
+    # ------------------------------------------------------------------
+    # 3PC message processing
+    # ------------------------------------------------------------------
+
+    def _common_checks(self, msg, key: Tuple[int, int]):
+        """Shared view/watermark admission checks; verdict or None=pass."""
+        view_no, pp_seq_no = key
+        if view_no < self._data.view_no:
+            return DISCARD, "old view"
+        if view_no > self._data.view_no:
+            return STASH_VIEW_3PC, "future view"
+        if self._data.waiting_for_new_view:
+            return STASH_WAITING_NEW_VIEW, "waiting for NEW_VIEW"
+        if not self._data.is_participating:
+            return STASH_CATCH_UP, "catching up"
+        if pp_seq_no <= self._data.low_watermark:
+            return DISCARD, "below watermark"
+        if pp_seq_no > self._data.high_watermark:
+            return STASH_WATERMARKS, "above high watermark"
+        return None
+
+    def _raise_suspicion(self, sender: str, suspicion) -> None:
+        self._bus.send(RaisedSuspicion(
+            inst_id=self._data.inst_id,
+            ex=SuspiciousNode(sender, suspicion)))
+
+    def process_preprepare(self, pp: PrePrepare, sender: str):
+        key = (pp.viewNo, pp.ppSeqNo)
+        verdict = self._common_checks(pp, key)
+        if verdict is not None:
+            return verdict
+        if sender != self._data.primary_name:
+            self._raise_suspicion(sender, Suspicions.PPR_FRM_NON_PRIMARY)
+            return DISCARD, "PRE-PREPARE from non-primary"
+        existing = self.prePrepares.get(key)
+        if existing is not None:
+            if existing.digest != pp.digest:
+                self._raise_suspicion(sender, Suspicions.DUPLICATE_PPR_SENT)
+            return DISCARD, "duplicate PRE-PREPARE"
+        try:
+            self._bls.validate_pre_prepare(pp, sender)
+        except SuspiciousNode as ex:
+            self._bus.send(RaisedSuspicion(self._data.inst_id, ex))
+            return DISCARD, "bad BLS multi-sig"
+
+        # all referenced requests must be finalised here too
+        if self._requests is not None:
+            missing = [d for d in pp.reqIdr
+                       if self._requests.get(d) is None]
+            if missing:
+                self._bus.send(RequestPropagates(missing))
+                return STASH_WAITING_REQUESTS, f"missing {len(missing)} reqs"
+
+        if pp.digest != self._batch_digest(list(pp.reqIdr)):
+            self._raise_suspicion(sender, Suspicions.PPR_DIGEST_WRONG)
+            return DISCARD, "digest mismatch"
+
+        # speculative re-apply on master: roots must match the primary's.
+        # Application MUST be in ppSeqNo order (roots chain) — a PRE-PREPARE
+        # arriving ahead of its predecessor is stashed, not mis-applied.
+        if self._is_master and self._executor is not None \
+                and self._requests is not None:
+            committed = self._executor.committed_seq()
+            floor = max(committed, self._last_applied_seq)
+            if pp.ppSeqNo > committed and pp.ppSeqNo != floor + 1:
+                return STASH_WAITING_PREV_PP, (
+                    f"out-of-order apply: {pp.ppSeqNo} after {floor}")
+            reqs = [self._requests.get(d) for d in pp.reqIdr]
+            state_root, txn_root = self._executor.apply_batch(
+                reqs, pp.ledgerId, pp.ppTime, pp.ppSeqNo)
+            if state_root != pp.stateRootHash:
+                self._executor.revert_batches(pp.ledgerId, 1)
+                self._raise_suspicion(sender, Suspicions.PPR_STATE_WRONG)
+                return DISCARD, "state root mismatch"
+            if txn_root != pp.txnRootHash:
+                self._executor.revert_batches(pp.ledgerId, 1)
+                self._raise_suspicion(sender, Suspicions.PPR_TXN_WRONG)
+                return DISCARD, "txn root mismatch"
+            self._last_applied_seq = max(floor, pp.ppSeqNo)
+
+        self.prePrepares[key] = pp
+        self.batches[key] = pp.ledgerId
+        self._data.preprepare_batch(preprepare_to_batch_id(pp))
+        self._bls.process_pre_prepare(pp, sender)
+
+        if not self._data.is_primary_in_view:
+            self._send_prepare(pp)
+        self._try_prepared(key)
+        # the successor PRE-PREPARE may be waiting on this one
+        self._stasher.process_stashed(STASH_WAITING_PREV_PP)
+        return PROCESS
+
+    def on_request_finalised(self) -> None:
+        """Node hook: newly finalised requests may unblock stashed PPs."""
+        self._stasher.process_stashed(STASH_WAITING_REQUESTS)
+
+    def _send_prepare(self, pp: PrePrepare) -> None:
+        prepare = Prepare(
+            instId=self._data.inst_id,
+            viewNo=pp.viewNo,
+            ppSeqNo=pp.ppSeqNo,
+            ppTime=pp.ppTime,
+            digest=pp.digest,
+            stateRootHash=pp.stateRootHash,
+            txnRootHash=pp.txnRootHash,
+        )
+        key = (pp.viewNo, pp.ppSeqNo)
+        self.prepares.setdefault(key, {})[self.name] = prepare
+        self._network.send(prepare)
+
+    def process_prepare(self, prepare: Prepare, sender: str):
+        key = (prepare.viewNo, prepare.ppSeqNo)
+        verdict = self._common_checks(prepare, key)
+        if verdict is not None:
+            return verdict
+        primary_name = self._data.primary_name
+        if sender == primary_name:
+            self._raise_suspicion(sender, Suspicions.PR_FRM_PRIMARY)
+            return DISCARD, "PREPARE from primary"
+        votes = self.prepares.setdefault(key, {})
+        if sender in votes:
+            self._raise_suspicion(sender, Suspicions.DUPLICATE_PR_SENT)
+            return DISCARD, "duplicate PREPARE"
+        pp = self.prePrepares.get(key)
+        if pp is not None and prepare.digest != pp.digest:
+            self._raise_suspicion(sender, Suspicions.PR_DIGEST_WRONG)
+            return DISCARD, "PREPARE digest mismatch"
+        votes[sender] = prepare
+        self._bls.process_prepare(prepare, sender)
+        self._try_prepared(key)
+        return PROCESS
+
+    def _has_prepare_quorum(self, key: Tuple[int, int]) -> bool:
+        votes = self.prepares.get(key, {})
+        others = [s for s in votes if s != self._data.primary_name]
+        return self._data.quorums.prepare.is_reached(len(others))
+
+    def _try_prepared(self, key: Tuple[int, int]) -> None:
+        pp = self.prePrepares.get(key)
+        if pp is None or not self._has_prepare_quorum(key):
+            return
+        bid = preprepare_to_batch_id(pp)
+        if bid in self._data.prepared:
+            return
+        # votes must match the accepted PRE-PREPARE digest
+        self._data.prepare_batch(bid)
+        self._send_commit(pp)
+
+    def _send_commit(self, pp: PrePrepare) -> None:
+        key = (pp.viewNo, pp.ppSeqNo)
+        params = dict(instId=self._data.inst_id, viewNo=pp.viewNo,
+                      ppSeqNo=pp.ppSeqNo)
+        params = self._bls.update_commit(params, pp)
+        commit = Commit(**params)
+        self.commits.setdefault(key, {})[self.name] = commit
+        self._network.send(commit)
+        self._try_order(key)
+
+    def process_commit(self, commit: Commit, sender: str):
+        key = (commit.viewNo, commit.ppSeqNo)
+        verdict = self._common_checks(commit, key)
+        if verdict is not None:
+            return verdict
+        votes = self.commits.setdefault(key, {})
+        if sender in votes:
+            self._raise_suspicion(sender, Suspicions.DUPLICATE_CM_SENT)
+            return DISCARD, "duplicate COMMIT"
+        pp = self.prePrepares.get(key)
+        try:
+            self._bls.validate_commit(commit, sender, pp)
+        except SuspiciousNode as ex:
+            self._bus.send(RaisedSuspicion(self._data.inst_id, ex))
+            return DISCARD, "bad BLS sig in COMMIT"
+        votes[sender] = commit
+        self._bls.process_commit(commit, sender)
+        self._try_order(key)
+        return PROCESS
+
+    # ------------------------------------------------------------------
+    # ordering
+    # ------------------------------------------------------------------
+
+    def _has_commit_quorum(self, key: Tuple[int, int]) -> bool:
+        return self._data.quorums.commit.is_reached(
+            len(self.commits.get(key, {})))
+
+    def _can_order(self, key: Tuple[int, int]) -> bool:
+        pp = self.prePrepares.get(key)
+        if pp is None:
+            return False
+        bid = preprepare_to_batch_id(pp)
+        if bid not in self._data.prepared:
+            return False
+        if not self._has_commit_quorum(key):
+            return False
+        if key in self.ordered:
+            return False
+        # strict in-order delivery within the view
+        view_no, pp_seq_no = key
+        last_view, last_seq = self._data.last_ordered_3pc
+        return pp_seq_no == last_seq + 1
+
+    def _try_order(self, key: Tuple[int, int]) -> None:
+        # drain in order: the commit quorum for seq k may have arrived
+        # before k-1 ordered
+        progressed = True
+        while progressed:
+            progressed = False
+            nxt = (self._data.view_no, self._data.last_ordered_3pc[1] + 1)
+            if self._can_order(nxt):
+                self._order_3pc_key(nxt)
+                progressed = True
+
+    def _order_3pc_key(self, key: Tuple[int, int]) -> None:
+        pp = self.prePrepares[key]
+        self.ordered.add(key)
+        self._data.last_ordered_3pc = key
+        self._bls.process_order(key, self._data.quorums, pp)
+        ordered = Ordered(
+            instId=self._data.inst_id,
+            viewNo=pp.viewNo,
+            ppSeqNo=pp.ppSeqNo,
+            ppTime=pp.ppTime,
+            reqIdr=list(pp.reqIdr),
+            discarded=pp.discarded,
+            ledgerId=pp.ledgerId,
+            stateRootHash=pp.stateRootHash,
+            txnRootHash=pp.txnRootHash,
+            auditTxnRootHash=pp.auditTxnRootHash,
+            originalViewNo=pp.originalViewNo,
+            digest=pp.digest,
+        )
+        logger.debug("%s ordered %s", self.name, key)
+        self._bus.send(ordered)
+
+    # ------------------------------------------------------------------
+    # view change integration
+    # ------------------------------------------------------------------
+
+    def process_view_change_started(self, msg: ViewChangeStarted) -> None:
+        """Revert uncommitted batches; retain PrePrepares for re-ordering."""
+        if self._is_master and self._executor is not None:
+            # revert unordered speculatively-applied batches (newest first)
+            unordered = [k for k in self.prePrepares
+                         if k not in self.ordered]
+            by_ledger: Dict[int, int] = {}
+            for k in unordered:
+                lid = self.batches.get(k, DOMAIN_LEDGER_ID)
+                by_ledger[lid] = by_ledger.get(lid, 0) + 1
+            for lid, count in by_ledger.items():
+                self._executor.revert_batches(lid, count)
+            self._last_applied_seq = self._executor.committed_seq()
+        for key, pp in self.prePrepares.items():
+            orig = pp.originalViewNo if pp.originalViewNo is not None \
+                else pp.viewNo
+            self.old_view_preprepares[(orig, pp.ppSeqNo, pp.digest)] = pp
+        self.sent_preprepares.clear()
+        self.prePrepares.clear()
+        self.prepares.clear()
+        self.commits.clear()
+        self.batches.clear()
+
+    def process_new_view_checkpoints_applied(
+            self, msg: NewViewCheckpointsApplied) -> None:
+        """Re-order the batches selected by NEW_VIEW in the new view."""
+        cp_view, cp_seq, _ = msg.checkpoint
+        # EVERY batch above the checkpoint is re-ordered in the new view,
+        # including ones this node already ordered — its 3PC votes are
+        # needed by peers that had not. Double-execution is prevented by
+        # the executor seam (historical roots) and the node-level ordered
+        # dedup on ppSeqNo.
+        self._data.pp_seq_no = cp_seq
+        self._data.last_ordered_3pc = (msg.view_no, cp_seq)
+        self.ordered.clear()  # keys were in the old view; all re-keyed now
+        self._data.clear_batches()
+        for bid in msg.batches:
+            view_no, pp_view_no, pp_seq_no, digest = bid
+            old_pp = self.old_view_preprepares.get(
+                (pp_view_no, pp_seq_no, digest))
+            if old_pp is None:
+                logger.warning("%s missing old PrePrepare for %s",
+                               self.name, bid)
+                continue
+            params = old_pp._fields
+            params.update(viewNo=msg.view_no,
+                          originalViewNo=pp_view_no)
+            new_pp = PrePrepare(**params)
+            self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
+            if self._data.is_primary_in_view:
+                key = (new_pp.viewNo, new_pp.ppSeqNo)
+                self.sent_preprepares[key] = new_pp
+                self.prePrepares[key] = new_pp
+                self.batches[key] = new_pp.ledgerId
+                self._data.preprepare_batch(preprepare_to_batch_id(new_pp))
+                self._network.send(new_pp)
+                self._try_prepared(key)
+            else:
+                # process as if received from the new primary
+                self.process_preprepare(new_pp, self._data.primary_name)
+        self._stasher.process_all_stashed()
+
+    def process_checkpoint_stabilized(self, msg: CheckpointStabilized) -> None:
+        """GC 3PC logs at or below the new stable checkpoint."""
+        stable_seq = msg.last_stable_3pc[1]
+        self._data.low_watermark = stable_seq
+        self._data.stable_checkpoint = stable_seq
+        self._data.free_upto(stable_seq)
+        for store in (self.sent_preprepares, self.prePrepares,
+                      self.prepares, self.commits, self.batches):
+            for key in [k for k in store if k[1] <= stable_seq]:
+                del store[key]
+        self.ordered = {k for k in self.ordered if k[1] > stable_seq}
+        self.old_view_preprepares = {
+            k: v for k, v in self.old_view_preprepares.items()
+            if k[1] > stable_seq}
+        self._bls.gc(msg.last_stable_3pc)
+        self._stasher.process_stashed(STASH_WATERMARKS)
+
+    # --- introspection (tests / monitor) ------------------------------
+
+    def l_last_ordered(self) -> Tuple[int, int]:
+        return self._data.last_ordered_3pc

@@ -1,0 +1,23 @@
+"""Deterministic primary selection: round-robin over the validator list.
+
+Reference: plenum/server/consensus/primary_selector.py
+(`RoundRobinConstantNodesPrimariesSelector`). Master primary for view v is
+validators[v mod N]; backup instance i gets validators[(v + i) mod N].
+All nodes compute the same list with no communication.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+class RoundRobinConstantNodesPrimariesSelector:
+    def __init__(self, validators: List[str]):
+        self.validators = list(validators)
+
+    def select_primaries(self, view_no: int, instance_count: int) -> List[str]:
+        n = len(self.validators)
+        return [self.validators[(view_no + i) % n]
+                for i in range(instance_count)]
+
+    def select_master_primary(self, view_no: int) -> str:
+        return self.validators[view_no % len(self.validators)]

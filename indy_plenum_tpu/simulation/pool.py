@@ -1,0 +1,244 @@
+"""Simulated consensus pool: N full replica stacks on one virtual clock.
+
+Reference pattern: plenum/test/simulation/ — ReplicaServices exchanging
+messages through an in-memory network under a seeded random schedule.
+Each simulated node wires the real consensus services (ordering,
+checkpoint, view change, trigger, primary monitor, message-req) exactly as
+the production Replica does; only the executor and request source are
+simple in-memory fakes. This is the tier-5 harness AND the integration
+surface for consensus changes (see .claude/skills/verify).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+from ..common.constants import DOMAIN_LEDGER_ID
+from ..common.event_bus import InternalBus
+from ..common.messages.node_messages import Ordered
+from ..common.request import Request
+from ..common.stashing_router import StashingRouter
+from ..config import Config, getConfig
+from ..server.consensus.checkpoint_service import CheckpointService
+from ..server.consensus.consensus_shared_data import ConsensusSharedData
+from ..server.consensus.message_req_service import MessageReqService
+from ..server.consensus.ordering_service import (
+    Executor,
+    OrderingService,
+    RequestsPool,
+)
+from ..server.consensus.primary_connection_monitor_service import (
+    PrimaryConnectionMonitorService,
+)
+from ..server.consensus.primary_selector import (
+    RoundRobinConstantNodesPrimariesSelector,
+)
+from ..server.consensus.view_change_service import ViewChangeService
+from ..server.consensus.view_change_trigger_service import (
+    ViewChangeTriggerService,
+)
+from .mock_timer import MockTimer
+from .sim_network import SimNetwork
+
+
+class SimExecutor(Executor):
+    """Deterministic fake execution: roots = rolling sha256 over digests.
+
+    Emulates the uncommitted-state behaviour of the real WriteRequestManager:
+    batches apply speculatively (LIFO-revertible) and, per the Executor
+    contract, an apply at or below the committed height returns the
+    memoized historical roots without touching state.
+    """
+
+    def __init__(self):
+        self.committed_chain = "genesis"
+        self._committed_seq = 0
+        self.roots_by_seq: Dict[int, str] = {}
+        self.batch_chains: List[str] = []  # uncommitted chain tips
+
+    def _root(self, chain: str) -> str:
+        from ..utils.base58 import b58encode
+
+        return b58encode(hashlib.sha256(chain.encode()).digest())
+
+    def apply_batch(self, reqs, ledger_id, pp_time, pp_seq_no):
+        if pp_seq_no <= self._committed_seq:
+            root = self.roots_by_seq[pp_seq_no]
+            return root, root
+        tip = self.batch_chains[-1] if self.batch_chains \
+            else self.committed_chain
+        new_tip = hashlib.sha256(
+            (tip + "".join(r.digest for r in reqs)).encode()).hexdigest()
+        self.batch_chains.append(new_tip)
+        root = self._root(new_tip)
+        return root, root
+
+    def revert_batches(self, ledger_id, count):
+        count = min(count, len(self.batch_chains))
+        if count:
+            del self.batch_chains[len(self.batch_chains) - count:]
+
+    def committed_seq(self) -> int:
+        return self._committed_seq
+
+    def commit_batch(self, pp_seq_no) -> None:
+        if pp_seq_no <= self._committed_seq:
+            return
+        assert self.batch_chains, "commit with nothing staged"
+        self.committed_chain = self.batch_chains.pop(0)
+        self._committed_seq = pp_seq_no
+        self.roots_by_seq[pp_seq_no] = self._root(self.committed_chain)
+
+
+class SimRequestsPool(RequestsPool):
+    """Finalised requests, shared across all nodes (propagation abstracted)."""
+
+    def __init__(self):
+        self._by_digest: Dict[str, Request] = {}
+        self._queues: Dict[str, List[str]] = {}  # per node name
+
+    def register_node(self, name: str) -> None:
+        self._queues[name] = []
+
+    def add_finalised(self, req: Request) -> None:
+        self._by_digest[req.digest] = req
+        for q in self._queues.values():
+            q.append(req.digest)
+
+    def view_for(self, name: str) -> "NodeRequestsView":
+        return NodeRequestsView(self, name)
+
+
+class NodeRequestsView(RequestsPool):
+    def __init__(self, pool: SimRequestsPool, name: str):
+        self._pool = pool
+        self._name = name
+
+    def pop_ready(self, ledger_id, max_count):
+        q = self._pool._queues[self._name]
+        take, self._pool._queues[self._name] = q[:max_count], q[max_count:]
+        return [self._pool._by_digest[d] for d in take]
+
+    def mark_ordered(self, digests) -> None:
+        """Ordered requests leave the pending queue on EVERY node — the
+        new primary after a view change must not re-propose them."""
+        gone = set(digests)
+        q = self._pool._queues[self._name]
+        self._pool._queues[self._name] = [d for d in q if d not in gone]
+
+    def get(self, digest):
+        return self._pool._by_digest.get(digest)
+
+    def has_ready(self, ledger_id):
+        return bool(self._pool._queues[self._name])
+
+    def ledger_ids_with_ready(self):
+        return [DOMAIN_LEDGER_ID] if self.has_ready(DOMAIN_LEDGER_ID) else []
+
+
+class SimNode:
+    """One simulated validator: the full consensus service stack."""
+
+    def __init__(self, name: str, validators: List[str], timer: MockTimer,
+                 network: SimNetwork, requests: SimRequestsPool,
+                 config: Config):
+        self.name = name
+        self.config = config
+        self.data = ConsensusSharedData(
+            name, validators, inst_id=0, is_master=True,
+            log_size=config.LOG_SIZE)
+        selector = RoundRobinConstantNodesPrimariesSelector(validators)
+        self.data.primaries = selector.select_primaries(0, 1)
+
+        self.internal_bus = InternalBus()
+        self.external_bus = network.create_peer(name)
+        self.stasher = StashingRouter(
+            limit=1000, buses=[self.internal_bus, self.external_bus])
+        self.executor = SimExecutor()
+        self.requests_view = requests.view_for(name)
+
+        self.ordering = OrderingService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            executor=self.executor, requests=self.requests_view,
+            config=config)
+        self.checkpoints = CheckpointService(
+            data=self.data, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher, config=config)
+        self.view_changer = ViewChangeService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            checkpoint_values_provider=self.checkpoints.own_checkpoint_values,
+            config=config)
+        self.vc_trigger = ViewChangeTriggerService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher, config=config)
+        self.primary_monitor = PrimaryConnectionMonitorService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, config=config)
+        self.message_req = MessageReqService(
+            data=self.data, bus=self.internal_bus,
+            network=self.external_bus, ordering_service=self.ordering,
+            view_change_service=self.view_changer)
+
+        # execution: commit batches as they order (the Node's job);
+        # re-ordered duplicates after a view change are skipped by seqNo
+        self.ordered_log: List[Ordered] = []
+        self.executed_upto = 0
+        self.internal_bus.subscribe(Ordered, self._on_ordered)
+        self.ordering.start()
+
+    def _on_ordered(self, ordered: Ordered, *args) -> None:
+        self.requests_view.mark_ordered(ordered.reqIdr)
+        if ordered.ppSeqNo <= self.executed_upto:
+            return  # already executed (re-ordered after view change)
+        self.executed_upto = ordered.ppSeqNo
+        self.ordered_log.append(ordered)
+        self.executor.commit_batch(ordered.ppSeqNo)
+
+    @property
+    def ordered_digests(self) -> List[str]:
+        out = []
+        for o in self.ordered_log:
+            out.extend(o.reqIdr)
+        return out
+
+
+class SimPool:
+    def __init__(self, n_nodes: int = 4, seed: int = 0,
+                 config: Optional[Config] = None):
+        self.config = config or getConfig(
+            {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
+        self.timer = MockTimer(start_time=1_700_000_000.0)
+        self.network = SimNetwork(self.timer, seed=seed)
+        self.validators = [f"node{i}" for i in range(n_nodes)]
+        self.requests = SimRequestsPool()
+        for name in self.validators:
+            self.requests.register_node(name)
+        self.nodes: List[SimNode] = [
+            SimNode(name, self.validators, self.timer, self.network,
+                    self.requests, self.config)
+            for name in self.validators]
+        self.network.connect_all()
+
+    def node(self, name: str) -> SimNode:
+        return next(n for n in self.nodes if n.name == name)
+
+    @property
+    def primary(self) -> SimNode:
+        return self.node(self.nodes[0].data.primaries[0])
+
+    def submit_request(self, seq: int) -> Request:
+        req = Request(identifier="client1", reqId=seq,
+                      operation={"type": "1", "v": seq})
+        self.requests.add_finalised(req)
+        return req
+
+    def run_for(self, seconds: float) -> None:
+        self.timer.advance(seconds)
+
+    def honest_nodes_agree(self) -> bool:
+        logs = [tuple(n.ordered_digests) for n in self.nodes]
+        lengths = {len(l) for l in logs}
+        shortest = min(lengths)
+        return all(l[:shortest] == logs[0][:shortest] for l in logs)

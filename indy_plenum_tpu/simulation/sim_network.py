@@ -1,0 +1,117 @@
+"""Deterministic in-memory network for multi-node simulation.
+
+Reference: plenum/test/simulation/ (sim_network, sim_random) and the
+delayer mechanism of plenum/test/delayers.py. Messages between nodes are
+delivered through the shared :class:`MockTimer` with configurable
+(seeded-random or fixed) latency; *delayers* are predicates that can hold
+back or drop specific message types from specific senders — the fault
+injector for partitions, slow links and byzantine silence.
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.event_bus import ExternalBus
+from .mock_timer import MockTimer
+
+# a delayer: (msg, frm, to) -> Optional[float]; None = deliver normally,
+# float = extra delay seconds, float('inf') = drop
+Delayer = Callable[[Any, str, str], Optional[float]]
+
+
+def delay_message_types(*types, frm: Optional[str] = None,
+                        to: Optional[str] = None,
+                        seconds: float = float("inf")) -> Delayer:
+    """Classic delayer (reference: ppDelay/pDelay/cDelay/icDelay...)."""
+
+    def delayer(msg, sender, dest):
+        if types and not isinstance(msg, types):
+            return None
+        if frm is not None and sender != frm:
+            return None
+        if to is not None and dest != to:
+            return None
+        return seconds
+
+    return delayer
+
+
+class SimNetwork:
+    def __init__(self, timer: MockTimer, seed: int = 0,
+                 min_latency: float = 0.01, max_latency: float = 0.05):
+        self._timer = timer
+        self._rng = random.Random(seed)
+        self._min_latency = min_latency
+        self._max_latency = max_latency
+        self._peers: Dict[str, ExternalBus] = {}
+        self._delayers: List[Delayer] = []
+        self.dropped = 0
+        self.sent = 0
+
+    # --- wiring ---------------------------------------------------------
+
+    def create_peer(self, name: str) -> ExternalBus:
+        bus = ExternalBus(self._make_send_handler(name))
+        self._peers[name] = bus
+        return bus
+
+    def connect_all(self) -> None:
+        for name, bus in self._peers.items():
+            bus.update_connecteds(set(self._peers) - {name})
+
+    def disconnect(self, name: str) -> None:
+        """Simulate a node dropping off the network."""
+        for peer, bus in self._peers.items():
+            if peer != name:
+                bus.update_connecteds(bus.connecteds - {name})
+        self._peers[name].update_connecteds(set())
+
+    def reconnect(self, name: str) -> None:
+        for peer, bus in self._peers.items():
+            if peer != name:
+                bus.update_connecteds(bus.connecteds | {name})
+        self._peers[name].update_connecteds(set(self._peers) - {name})
+
+    def add_delayer(self, delayer: Delayer) -> Callable[[], None]:
+        self._delayers.append(delayer)
+        return lambda: self._delayers.remove(delayer)
+
+    def reset_delays(self) -> None:
+        self._delayers.clear()
+
+    # --- delivery -------------------------------------------------------
+
+    def _make_send_handler(self, frm: str):
+        def send(msg, dst=None):
+            if dst is None:
+                targets = sorted(set(self._peers) - {frm})
+            elif isinstance(dst, str):
+                targets = [dst]
+            else:
+                targets = list(dst)
+            for to in targets:
+                self._deliver_later(msg, frm, to)
+
+        return send
+
+    def _deliver_later(self, msg, frm: str, to: str) -> None:
+        if to not in self._peers:
+            return
+        # link must be up (receiver sees sender as connected)
+        if frm not in self._peers[to].connecteds:
+            self.dropped += 1
+            return
+        latency = self._rng.uniform(self._min_latency, self._max_latency)
+        for delayer in list(self._delayers):
+            extra = delayer(msg, frm, to)
+            if extra is None:
+                continue
+            if extra == float("inf"):
+                self.dropped += 1
+                return
+            latency += extra
+        self.sent += 1
+        bus = self._peers[to]
+        self._timer.schedule(latency,
+                             lambda m=msg, f=frm, b=bus: b.process_incoming(m, f))

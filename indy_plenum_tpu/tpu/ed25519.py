@@ -33,8 +33,8 @@ from ..crypto import ed25519 as ref
 WINDOWS = 64  # 4-bit windows over 256-bit scalars
 
 # ---------------------------------------------------------------------------
-# Point representation: (..., 4, 16) int64 = extended (X, Y, Z, T) limbs.
-# Cached form for addition: (..., 4, 16) = (Y+X, Y-X, 2d*T, 2Z).
+# Point representation: (..., 4, NLIMBS=22) int32 = extended (X, Y, Z, T)
+# limbs. Cached form for addition: (..., 4, 22) = (Y+X, Y-X, 2d*T, 2Z).
 # ---------------------------------------------------------------------------
 
 _IDENTITY = np.stack(
@@ -90,7 +90,7 @@ def point_neg(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def decompress(b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(..., 32) uint8 -> (point (...,4,16), ok (...,) bool). RFC 8032 rules."""
+    """(..., 32) uint8 -> (point (...,4,22), ok (...,) bool). RFC 8032 rules."""
     y = fe.decode_bytes(b)
     sign = (b[..., 31].astype(jnp.int32) >> 7) & 1
     canonical = jnp.all(y == fe.freeze(y), axis=-1)
@@ -126,7 +126,7 @@ def compress(p: jnp.ndarray) -> jnp.ndarray:
 
 
 def _nibbles(s: jnp.ndarray) -> jnp.ndarray:
-    """(..., 32) uint8 scalar bytes -> (..., 64) int64 nibbles, little-endian."""
+    """(..., 32) uint8 scalar bytes -> (..., 64) int32 nibbles, little-endian."""
     s = s.astype(jnp.int32)
     lo = s & 0xF
     hi = (s >> 4) & 0xF
@@ -134,13 +134,13 @@ def _nibbles(s: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """One-hot select: table (..., 16, 4, 16) x idx (...,) -> (..., 4, 16)."""
+    """One-hot select: table (..., 16, 4, 22) x idx (...,) -> (..., 4, 22)."""
     oh = (idx[..., None] == jnp.arange(16, dtype=jnp.int32)).astype(jnp.int32)
     return jnp.sum(table * oh[..., :, None, None], axis=-3)
 
 
 def _base_table() -> np.ndarray:
-    """Static cached multiples j*B for j=0..15, shape (16, 4, 16)."""
+    """Static cached multiples j*B for j=0..15, shape (16, 4, 22)."""
     rows = [_IDENTITY_CACHED]
     for j in range(1, 16):
         X, Y, Z, T = ref.scalar_mult(j, ref.BASE)
@@ -177,11 +177,11 @@ def _verify_kernel(
         nxt = point_add_cached(pt, a_cached)
         return nxt, to_cached(nxt)
 
-    _, higher = lax.scan(table_step, a_neg, None, length=14)  # (14, B, 4, 16)
+    _, higher = lax.scan(table_step, a_neg, None, length=14)  # (14, B, 4, 22)
     table_a = jnp.concatenate(
         [ident_c[None], a_cached[None], higher], axis=0
-    )  # (16, B, 4, 16)
-    table_a = jnp.moveaxis(table_a, 0, -3)  # (B, 16, 4, 16)
+    )  # (16, B, 4, 22)
+    table_a = jnp.moveaxis(table_a, 0, -3)  # (B, 16, 4, 22)
 
     base_table = jnp.asarray(_BASE_TABLE)
     s_nib = _nibbles(s)  # (B, 64)

@@ -84,22 +84,28 @@ def _scatter_local(
     state: VoteState, msgs: MsgBatch, row_offset: jnp.ndarray, local_rows: int
 ) -> VoteState:
     """Scatter message batch into the local shard of the vote tensors."""
+    n_slots = state.prepare_votes.shape[1]
+    n_cslots = state.checkpoint_votes.shape[1]
     local = msgs.sender - row_offset
+    slot_ok = (msgs.slot >= 0) & (msgs.slot < n_slots)
+    cslot_ok = (msgs.slot >= 0) & (msgs.slot < n_cslots)
     mine = msgs.valid & (local >= 0) & (local < local_rows)
     lidx = jnp.clip(local, 0, local_rows - 1)
-    slot = jnp.clip(msgs.slot, 0, state.prepare_votes.shape[1] - 1)
-    cslot = jnp.clip(msgs.slot, 0, state.checkpoint_votes.shape[1] - 1)
+    slot = jnp.clip(msgs.slot, 0, n_slots - 1)
+    cslot = jnp.clip(msgs.slot, 0, n_cslots - 1)
 
-    def hits(kind):
-        return (msgs.kind == kind) & mine
+    def hits(kind, ok):
+        return (msgs.kind == kind) & mine & ok
 
-    pv = state.prepare_votes.at[lidx, slot].max(hits(PREPARE).astype(jnp.uint8))
-    cv = state.commit_votes.at[lidx, slot].max(hits(COMMIT).astype(jnp.uint8))
+    pv = state.prepare_votes.at[lidx, slot].max(
+        hits(PREPARE, slot_ok).astype(jnp.uint8))
+    cv = state.commit_votes.at[lidx, slot].max(
+        hits(COMMIT, slot_ok).astype(jnp.uint8))
     ck = state.checkpoint_votes.at[lidx, cslot].max(
-        hits(CHECKPOINT).astype(jnp.uint8)
+        hits(CHECKPOINT, cslot_ok).astype(jnp.uint8)
     )
     # PRE-PREPARE is per-slot, not per-validator: replicated across shards.
-    pp_hit = (msgs.kind == PREPREPARE) & msgs.valid
+    pp_hit = (msgs.kind == PREPREPARE) & msgs.valid & slot_ok
     pp = state.preprepare_seen.at[slot].max(pp_hit.astype(jnp.uint8))
     return VoteState(pp, pv, cv, ck, state.ordered)
 

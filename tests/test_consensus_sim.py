@@ -1,0 +1,140 @@
+"""Tier-5: simulated multi-node pool under a virtual clock.
+
+Mirrors the reference's plenum/test/simulation strategy: real consensus
+services, in-memory network with seeded random latencies, deterministic
+schedule. Properties: all honest replicas order the same batches; view
+change completes and ordering resumes; checkpoints advance watermarks.
+"""
+import pytest
+
+from indy_plenum_tpu.common.messages.node_messages import (
+    Commit,
+    InstanceChange,
+    NewView,
+    PrePrepare,
+    Prepare,
+    ViewChange,
+)
+from indy_plenum_tpu.simulation.pool import SimPool
+from indy_plenum_tpu.simulation.sim_network import delay_message_types
+
+
+def test_basic_ordering_4_nodes():
+    pool = SimPool(4, seed=1)
+    for i in range(25):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert len(node.ordered_digests) == 25, node.name
+        assert node.data.last_ordered_3pc[1] >= 1
+
+
+def test_ordering_is_deterministic_per_seed():
+    def run(seed):
+        pool = SimPool(4, seed=seed)
+        for i in range(12):
+            pool.submit_request(i)
+        pool.run_for(5)
+        return [n.ordered_digests for n in pool.nodes]
+
+    assert run(7) == run(7)
+
+
+def test_larger_pool_7_nodes():
+    pool = SimPool(7, seed=3)
+    for i in range(10):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert pool.honest_nodes_agree()
+    assert all(len(n.ordered_digests) == 10 for n in pool.nodes)
+
+
+def test_checkpoint_stabilization_advances_watermarks():
+    from indy_plenum_tpu.config import getConfig
+
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 1,
+                     "CHK_FREQ": 5, "LOG_SIZE": 15})
+    pool = SimPool(4, seed=2, config=cfg)
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(20)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert node.data.last_ordered_3pc[1] >= 12
+        assert node.data.stable_checkpoint >= 10, node.name
+        assert node.data.low_watermark == node.data.stable_checkpoint
+
+
+def test_view_change_on_primary_failure():
+    pool = SimPool(4, seed=4)
+    primary_name = pool.nodes[0].data.primaries[0]
+    assert primary_name == "node0"
+
+    # a few requests order normally first
+    for i in range(5):
+        pool.submit_request(i)
+    pool.run_for(5)
+    assert all(len(n.ordered_digests) == 5 for n in pool.nodes)
+
+    # primary goes dark
+    pool.network.disconnect(primary_name)
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 5)
+
+    survivors = [n for n in pool.nodes if n.name != primary_name]
+    for node in survivors:
+        assert node.data.view_no >= 1, (node.name, node.data.view_no)
+        assert not node.data.waiting_for_new_view, node.name
+        assert node.data.primaries[0] == "node1"
+
+    # ordering resumes in the new view with the new primary
+    for i in range(100, 108):
+        pool.submit_request(i)
+    pool.run_for(10)
+    for node in survivors:
+        assert len(node.ordered_digests) == 13, (
+            node.name, len(node.ordered_digests))
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+
+
+def test_view_change_preserves_prepared_batches():
+    """Batches prepared but not ordered before the VC must re-order after."""
+    pool = SimPool(4, seed=5)
+    primary_name = pool.nodes[0].data.primaries[0]
+
+    # Block COMMITs so batches get prepared but cannot order.
+    undelay = pool.network.add_delayer(delay_message_types(Commit))
+    for i in range(3):
+        pool.submit_request(i)
+    pool.run_for(3)
+    assert all(len(n.ordered_digests) == 0 for n in pool.nodes)
+    prepared_counts = [len(n.data.prepared) for n in pool.nodes]
+    assert any(c > 0 for c in prepared_counts)
+
+    # Primary dies; commits stay blocked until the new view is chosen.
+    pool.network.disconnect(primary_name)
+    undelay()
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 8)
+
+    survivors = [n for n in pool.nodes if n.name != primary_name]
+    for node in survivors:
+        assert node.data.view_no >= 1
+        assert not node.data.waiting_for_new_view
+    pool.run_for(5)
+    # the prepared batches were re-ordered in the new view
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+    assert len(logs[0]) == 3, logs[0]
+
+
+def test_delayers_slow_node_still_catches_up_in_window():
+    pool = SimPool(4, seed=6)
+    # node3 receives PREPAREs 1s late — still orders, just behind
+    pool.network.add_delayer(
+        delay_message_types(Prepare, to="node3", seconds=1.0))
+    for i in range(8):
+        pool.submit_request(i)
+    pool.run_for(15)
+    assert pool.honest_nodes_agree()
+    assert all(len(n.ordered_digests) == 8 for n in pool.nodes)

@@ -1,0 +1,115 @@
+"""Tier-1: merkle tree / ledger / kv store vs brute-force oracles."""
+import hashlib
+
+import pytest
+
+from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+from indy_plenum_tpu.ledger.hash_stores import KvHashStore, MemoryHashStore
+from indy_plenum_tpu.ledger.ledger import Ledger
+from indy_plenum_tpu.ledger.merkle_verifier import MerkleVerifier, STH
+from indy_plenum_tpu.ledger.tree_hasher import TreeHasher
+from indy_plenum_tpu.storage.kv_store import (
+    KeyValueStorageInMemory,
+    KeyValueStorageSqlite,
+)
+
+H = TreeHasher()
+LEAVES = [f"txn-{i}".encode() for i in range(130)]
+
+
+def test_root_matches_bruteforce():
+    tree = CompactMerkleTree()
+    for n, leaf in enumerate(LEAVES, 1):
+        tree.append(leaf)
+        assert tree.root_hash == H.hash_full_tree(LEAVES[:n]), n
+        assert tree.tree_size == n
+
+
+def test_historical_roots():
+    tree = CompactMerkleTree()
+    tree.extend(LEAVES)
+    for n in (0, 1, 2, 3, 7, 8, 64, 100, 130):
+        assert tree.root_hash_at(n) == H.hash_full_tree(LEAVES[:n])
+
+
+def test_audit_paths_verify():
+    tree = CompactMerkleTree()
+    tree.extend(LEAVES)
+    verifier = MerkleVerifier()
+    for size in (1, 2, 5, 64, 130):
+        sth = STH(size, tree.root_hash_at(size))
+        for idx in range(size):
+            path = tree.audit_path(idx, size)
+            assert verifier.verify_leaf_inclusion(
+                LEAVES[idx], idx, path, sth), (idx, size)
+        # negative: wrong leaf
+        path = tree.audit_path(0, size)
+        assert not verifier.verify_leaf_inclusion(b"evil", 0, path, sth)
+
+
+def test_consistency_proofs():
+    tree = CompactMerkleTree()
+    tree.extend(LEAVES)
+    verifier = MerkleVerifier()
+    for old in (1, 2, 3, 8, 64, 129):
+        for new in (old, old + 1, 100, 130):
+            if new < old or new > len(LEAVES):
+                continue
+            proof = tree.consistency_proof(old, new)
+            assert verifier.verify_consistency(
+                old, new, tree.root_hash_at(old), tree.root_hash_at(new),
+                proof), (old, new)
+    assert not verifier.verify_consistency(
+        8, 130, tree.root_hash_at(9), tree.root_hash_at(130),
+        tree.consistency_proof(8, 130))
+
+
+def test_persistence_reload(tmp_path):
+    kv = KeyValueStorageSqlite(str(tmp_path), "hashes")
+    tree = CompactMerkleTree(hash_store=KvHashStore(kv))
+    tree.extend(LEAVES[:100])
+    root = tree.root_hash
+    # reload from the same store
+    tree2 = CompactMerkleTree(hash_store=KvHashStore(kv))
+    assert tree2.tree_size == 100
+    assert tree2.root_hash == root
+    tree2.append(LEAVES[100])
+    assert tree2.root_hash == H.hash_full_tree(LEAVES[:101])
+
+
+def test_ledger_two_phase():
+    ledger = Ledger()
+    txns = [{"txn": {"type": "1", "data": {"k": i}, "metadata": {}},
+             "txnMetadata": {}, "ver": "1", "reqSignature": {}}
+            for i in range(10)]
+    committed_root_before = ledger.root_hash
+    start, end, staged = ledger.append_txns(txns[:6])
+    assert (start, end) == (1, 6)
+    assert ledger.size == 0 and ledger.uncommitted_size == 6
+    assert ledger.root_hash == committed_root_before  # staging is invisible
+    unc_root = ledger.uncommitted_root_hash
+    assert unc_root != committed_root_before
+
+    (s, e), done = ledger.commit_txns(4)
+    assert (s, e) == (1, 4) and len(done) == 4
+    assert ledger.size == 4
+    ledger.discard_txns(2)
+    assert ledger.uncommitted_size == 4
+    # committing everything staged earlier then re-staging works
+    ledger.append_txns(txns[6:8])
+    (s, e), _ = ledger.commit_txns(2)
+    assert (s, e) == (5, 6)
+    assert ledger.get_by_seq_no(5)["txn"]["data"]["k"] == 6
+    # uncommitted root equals committed root after all staged committed
+    assert ledger.uncommitted_root_hash == ledger.root_hash
+
+
+def test_kv_iterator_order():
+    for kv in (KeyValueStorageInMemory(),):
+        kv.put(b"b", b"2")
+        kv.put(b"a", b"1")
+        kv.put(b"c", b"3")
+        assert [k for k, _ in kv.iterator()] == [b"a", b"b", b"c"]
+        assert [k for k, _ in kv.iterator(start=b"b")] == [b"b", b"c"]
+        kv.do_batch([(b"d", b"4"), (b"a", None)])
+        assert not kv.has_key(b"a") and kv.get(b"d") == b"4"

@@ -1,0 +1,112 @@
+"""Tier-1: message schema validation, request digests, config overlay."""
+import pytest
+
+from indy_plenum_tpu.common.constants import f
+from indy_plenum_tpu.common.exceptions import (
+    InvalidClientRequest,
+    InvalidMessageError,
+)
+from indy_plenum_tpu.common.messages.message_base import node_message_registry
+from indy_plenum_tpu.common.messages.node_messages import (
+    Checkpoint,
+    Commit,
+    PrePrepare,
+    Prepare,
+    ViewChange,
+    batch_id,
+)
+from indy_plenum_tpu.common.request import Request, SafeRequest
+from indy_plenum_tpu.common.serializers.serialization import (
+    deserialize_msgpack,
+    serialize_for_signing,
+    serialize_msg,
+)
+from indy_plenum_tpu.config import getConfig
+
+ROOT = "GKot5hBsd81kMupNCXHaqbhv3huEbxAFMLnpcX2hniwn"  # b58 of 32 bytes
+
+
+def mk_preprepare(**over):
+    kw = dict(instId=0, viewNo=0, ppSeqNo=1, ppTime=1700000000,
+              reqIdr=["d1", "d2"], discarded=0, digest="batchdigest",
+              ledgerId=1, stateRootHash=ROOT, txnRootHash=ROOT,
+              sub_seq_no=0, final=True)
+    kw.update(over)
+    return PrePrepare(**kw)
+
+
+def test_preprepare_roundtrip_and_validation():
+    pp = mk_preprepare()
+    wire = serialize_msg(pp.as_dict())
+    back = node_message_registry.obj_from_dict(deserialize_msgpack(wire))
+    assert back == pp
+    assert back.ppSeqNo == 1
+    with pytest.raises(InvalidMessageError):
+        mk_preprepare(ppSeqNo=-1)
+    with pytest.raises(InvalidMessageError):
+        mk_preprepare(stateRootHash="not-base58-$$$")
+    with pytest.raises(InvalidMessageError):
+        PrePrepare(instId=0)  # missing required fields
+    with pytest.raises(AttributeError):
+        pp2 = mk_preprepare()
+        pp2.ppSeqNo = 5  # immutable
+
+
+def test_other_messages():
+    Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=1700000000,
+            digest="d", stateRootHash=ROOT, txnRootHash=ROOT)
+    Commit(instId=0, viewNo=0, ppSeqNo=1)
+    Checkpoint(instId=0, viewNo=0, seqNoStart=1, seqNoEnd=100, digest="d")
+    vc = ViewChange(viewNo=1, stableCheckpoint=0,
+                    prepared=[batch_id(0, 0, 1, "d")],
+                    preprepared=[], checkpoints=[[0, 0, "d"]])
+    assert vc.prepared[0][2] == 1
+    with pytest.raises(InvalidMessageError):
+        ViewChange(viewNo=1, stableCheckpoint=0,
+                   prepared=[["bad", 0, 1, "d"]], preprepared=[],
+                   checkpoints=[])
+
+
+def test_request_digest_stability():
+    r1 = Request(identifier="id1", reqId=7, operation={"type": "1", "k": "v"})
+    r2 = Request(identifier="id1", reqId=7, operation={"k": "v", "type": "1"})
+    assert r1.digest == r2.digest  # key order irrelevant (canonical signing)
+    r3 = Request(identifier="id1", reqId=8, operation={"type": "1", "k": "v"})
+    assert r1.digest != r3.digest
+    # signature does not affect the digest
+    r4 = Request(identifier="id1", reqId=7, operation={"type": "1", "k": "v"},
+                 signature="sig")
+    assert r4.digest == r1.digest
+    assert r1.payload_digest == r4.payload_digest
+    assert r1.payload_digest != r1.digest
+
+
+def test_safe_request_rejects_garbage():
+    ok = SafeRequest(**{
+        f.IDENTIFIER: "4QxzWk3ajdnEA37NdNU5Kt",  # 16-byte DID b58
+        f.REQ_ID: 1, f.OPERATION: {"type": "1"},
+        f.SIGNATURE: "x" * 10, f.PROTOCOL_VERSION: 2})
+    assert ok.reqId == 1
+    with pytest.raises(InvalidClientRequest):
+        SafeRequest(**{f.IDENTIFIER: "4QxzWk3ajdnEA37NdNU5Kt",
+                       f.REQ_ID: 1, f.OPERATION: {"type": "1"}})  # no sig
+    with pytest.raises(InvalidClientRequest):
+        SafeRequest(**{f.IDENTIFIER: "!!!", f.REQ_ID: 1,
+                       f.OPERATION: {"type": "1"}, f.SIGNATURE: "s"})
+
+
+def test_signing_serialization_canonical():
+    a = serialize_for_signing({"b": 1, "a": {"y": None, "x": 2}})
+    b = serialize_for_signing({"a": {"x": 2}, "b": 1})
+    assert a == b  # sorted keys, None dropped
+
+
+def test_config_overlay():
+    cfg = getConfig()
+    assert cfg.CHK_FREQ == 100 and cfg.LOG_SIZE == 300
+    cfg2 = getConfig({"Max3PCBatchSize": 5})
+    assert cfg2.Max3PCBatchSize == 5 and cfg.Max3PCBatchSize == 100
+    with pytest.raises(KeyError):
+        getConfig({"NoSuchKey": 1})
+    assert cfg.replicas_count(4) == 2  # f=1 -> master + 1 backup
+    assert cfg.replicas_count(10) == 4

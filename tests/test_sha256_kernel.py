@@ -1,0 +1,73 @@
+"""Tier-1: device SHA-256 + batched audit-path verification vs hashlib."""
+import hashlib
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree  # noqa: E402
+from indy_plenum_tpu.ledger.tree_hasher import TreeHasher  # noqa: E402
+from indy_plenum_tpu.tpu import sha256 as dsha  # noqa: E402
+
+
+def test_sha256_fixed_lengths():
+    rng = np.random.RandomState(0)
+    for msg_len in (0, 1, 32, 55, 56, 64, 65, 100, 128):
+        batch = rng.randint(0, 256, (8, msg_len)).astype(np.uint8)
+        got = np.asarray(dsha.sha256_fixed(jnp.asarray(batch), msg_len))
+        for i in range(8):
+            want = hashlib.sha256(batch[i].tobytes()).digest()
+            assert got[i].tobytes() == want, msg_len
+
+
+def test_merkle_node_hash():
+    left = np.arange(32, dtype=np.uint8)[None].repeat(4, 0)
+    right = (np.arange(32, dtype=np.uint8) + 100)[None].repeat(4, 0)
+    got = np.asarray(dsha.merkle_node_hash(jnp.asarray(left),
+                                           jnp.asarray(right)))
+    want = hashlib.sha256(b"\x01" + left[0].tobytes()
+                          + right[0].tobytes()).digest()
+    assert all(got[i].tobytes() == want for i in range(4))
+
+
+def test_batched_audit_path_verify():
+    leaves = [f"txn-{i}".encode() for i in range(100)]
+    tree = CompactMerkleTree()
+    tree.extend(leaves)
+    hasher = TreeHasher()
+    size = tree.tree_size
+    root = tree.root_hash
+
+    max_depth = 8
+    idxs = list(range(0, 100, 7))
+    B = len(idxs)
+    leaf_hash = np.zeros((B, 32), np.uint8)
+    path = np.zeros((B, max_depth, 32), np.uint8)
+    plen = np.zeros(B, np.int32)
+    for j, idx in enumerate(idxs):
+        leaf_hash[j] = np.frombuffer(hasher.hash_leaf(leaves[idx]), np.uint8)
+        ap = tree.audit_path(idx, size)
+        plen[j] = len(ap)
+        for lv, h in enumerate(ap):
+            path[j, lv] = np.frombuffer(h, np.uint8)
+    roots = np.broadcast_to(np.frombuffer(root, np.uint8), (B, 32)).copy()
+
+    ok = np.asarray(dsha.verify_audit_paths(
+        jnp.asarray(leaf_hash), jnp.asarray(np.array(idxs, np.int32)),
+        jnp.asarray(path), jnp.asarray(plen),
+        jnp.asarray(np.full(B, size, np.int32)), jnp.asarray(roots)))
+    assert ok.all()
+
+    # corruption: flip a byte in one path; wrong root for another
+    path[2, 0, 0] ^= 1
+    roots[5, 3] ^= 1
+    plen2 = plen.copy()
+    plen2[7] -= 1  # truncated path
+    ok = np.asarray(dsha.verify_audit_paths(
+        jnp.asarray(leaf_hash), jnp.asarray(np.array(idxs, np.int32)),
+        jnp.asarray(path), jnp.asarray(plen2),
+        jnp.asarray(np.full(B, size, np.int32)), jnp.asarray(roots)))
+    expected = np.ones(B, bool)
+    expected[[2, 5, 7]] = False
+    assert list(ok) == list(expected)

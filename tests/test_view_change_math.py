@@ -1,0 +1,87 @@
+"""Tier-1: NEW_VIEW checkpoint/batch selection math (pure functions).
+
+SURVEY.md §7 ranks faithful view-change edge cases among the hard parts;
+these tests pin the selection rules directly.
+"""
+from indy_plenum_tpu.common.messages.node_messages import ViewChange
+from indy_plenum_tpu.server.consensus.view_change_service import (
+    calc_batches,
+    calc_checkpoint,
+    view_change_digest,
+)
+from indy_plenum_tpu.server.quorums import Quorums
+
+Q4 = Quorums(4)  # f = 1
+
+
+def vc(prepared=(), preprepared=(), checkpoints=((0, 0, "stable"),),
+       view_no=1, stable=0):
+    return ViewChange(
+        viewNo=view_no,
+        stableCheckpoint=stable,
+        prepared=[list(b) for b in prepared],
+        preprepared=[list(b) for b in preprepared],
+        checkpoints=[list(c) for c in checkpoints],
+    )
+
+
+def test_checkpoint_needs_weak_quorum():
+    # only one VC carries checkpoint 100 -> not selectable
+    vcs = [vc(checkpoints=[(0, 100, "d"), (0, 0, "stable")]),
+           vc(), vc()]
+    assert calc_checkpoint(vcs, Q4) == (0, 0, "stable")
+    # two VCs carry it (f+1=2) -> selected (highest wins)
+    vcs = [vc(checkpoints=[(0, 100, "d"), (0, 0, "stable")]),
+           vc(checkpoints=[(0, 100, "d"), (0, 0, "stable")]), vc()]
+    assert calc_checkpoint(vcs, Q4) == (0, 100, "d")
+
+
+def test_no_checkpoint_when_no_overlap():
+    vcs = [vc(checkpoints=[(0, 10, "a")]), vc(checkpoints=[(0, 20, "b")]),
+           vc(checkpoints=[(0, 30, "c")])]
+    assert calc_checkpoint(vcs, Q4) is None
+
+
+def test_batch_selection_requires_one_prepared_and_weak_preprepared():
+    b1 = (1, 0, 1, "digest1")
+    # prepared in one VC, preprepared in two -> selected
+    vcs = [vc(prepared=[b1], preprepared=[b1]),
+           vc(preprepared=[b1]),
+           vc()]
+    got = calc_batches((0, 0, "stable"), vcs, Q4)
+    assert got == [list(b1)]
+    # prepared nowhere -> not selected (even if widely preprepared)
+    vcs = [vc(preprepared=[b1]), vc(preprepared=[b1]), vc(preprepared=[b1])]
+    assert calc_batches((0, 0, "stable"), vcs, Q4) == []
+    # preprepared only once -> digest unauthenticated -> not selected
+    vcs = [vc(prepared=[b1], preprepared=[b1]), vc(), vc()]
+    assert calc_batches((0, 0, "stable"), vcs, Q4) == []
+
+
+def test_batches_below_checkpoint_dropped_and_sorted():
+    b1 = (1, 0, 5, "d5")
+    b2 = (1, 0, 3, "d3")
+    b3 = (1, 0, 7, "d7")
+    vcs = [vc(prepared=[b1, b2, b3], preprepared=[b1, b2, b3]),
+           vc(preprepared=[b1, b2, b3]),
+           vc()]
+    got = calc_batches((0, 4, "cp"), vcs, Q4)
+    assert got == [list(b1), list(b3)]  # 3 <= checkpoint 4 dropped; sorted
+
+
+def test_at_most_one_batch_per_seqno():
+    a = (1, 0, 5, "digA")
+    b = (1, 0, 5, "digB")
+    vcs = [vc(prepared=[a], preprepared=[a, b]),
+           vc(prepared=[b], preprepared=[a, b]),
+           vc(preprepared=[a, b])]
+    got = calc_batches((0, 0, "stable"), vcs, Q4)
+    assert len(got) == 1  # deterministic pick, never both
+
+
+def test_view_change_digest_stable():
+    v1 = vc(prepared=[(1, 0, 1, "x")])
+    v2 = vc(prepared=[(1, 0, 1, "x")])
+    assert view_change_digest(v1) == view_change_digest(v2)
+    v3 = vc(prepared=[(1, 0, 2, "x")])
+    assert view_change_digest(v1) != view_change_digest(v3)

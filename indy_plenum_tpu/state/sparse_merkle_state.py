@@ -1,0 +1,259 @@
+"""Authenticated key/value state as a binary sparse Merkle tree (SMT).
+
+Replaces the reference's Merkle Patricia Trie (state/trie/pruning_trie.py)
+with a TPU-friendly fixed-depth structure:
+
+- path = sha256(key): 256 bits, one tree level per bit;
+- empty subtrees use precomputed per-level default hashes and are never
+  stored, so storage is O(written keys * 256) content-addressed nodes;
+- nodes are content-addressed (hash -> (left, right) / leaf payload) in a
+  KeyValueStorage, which makes every historical root remain readable —
+  committed vs uncommitted heads are just two root pointers, and
+  ``revert_to_head`` is a pointer assignment (the reference's
+  revertToHead walks and prunes; here old roots are free);
+- a state proof for a key is the 256 sibling hashes, compressed with a
+  bitmap marking defaults (typically ~10 non-default siblings), and
+  verification is a fixed 256-step hash fold — batchable on device.
+
+Leaf hash = H(0x00 || path || value); node hash = H(0x01 || l || r);
+default leaf = H(b"") per level 256, defaults[l] = H(0x01||d||d) upward.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+import msgpack
+
+from ..storage.kv_store import KeyValueStorage, KeyValueStorageInMemory
+from .state import State
+
+DEPTH = 256
+_LEAF_PREFIX = b"\x00"
+_NODE_PREFIX = b"\x01"
+
+
+def _h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _defaults() -> List[bytes]:
+    """defaults[level] = hash of an empty subtree whose root is at level.
+
+    level DEPTH = leaves; level 0 = tree root.
+    """
+    out = [b""] * (DEPTH + 1)
+    out[DEPTH] = _h(b"")
+    for level in range(DEPTH - 1, -1, -1):
+        out[level] = _h(_NODE_PREFIX + out[level + 1] + out[level + 1])
+    return out
+
+
+DEFAULTS = _defaults()
+EMPTY_ROOT = DEFAULTS[0]
+
+
+def _path_bits(key: bytes) -> List[int]:
+    digest = _h(key)
+    return [(digest[i // 8] >> (7 - i % 8)) & 1 for i in range(DEPTH)]
+
+
+class SparseMerkleState(State):
+    def __init__(self, kv: Optional[KeyValueStorage] = None,
+                 initial_root: Optional[bytes] = None):
+        self._kv = kv if kv is not None else KeyValueStorageInMemory()
+        # write-buffer: uncommitted nodes stay in memory; commit() flushes
+        # them to the KV backend in one atomic batch (a crash between
+        # batches loses only uncommitted state, as with the reference)
+        self._dirty: dict[bytes, bytes] = {}
+        root = initial_root or self._load_root() or EMPTY_ROOT
+        self._committed_root = root
+        self._root = root
+
+    # --- persistence of the committed head pointer ---------------------
+
+    _ROOT_KEY = b"\xffROOT"
+
+    def _load_root(self) -> Optional[bytes]:
+        try:
+            return self._kv.get(self._ROOT_KEY)
+        except KeyError:
+            return None
+
+    def _store_root(self) -> None:
+        self._kv.put(self._ROOT_KEY, self._committed_root)
+
+    # --- node store ----------------------------------------------------
+
+    def _put_node(self, data: bytes) -> bytes:
+        h = _h(data)
+        self._dirty[b"n" + h] = data
+        return h
+
+    def _get_node(self, h: bytes) -> bytes:
+        key = b"n" + h
+        if key in self._dirty:
+            return self._dirty[key]
+        return self._kv.get(key)
+
+    # --- core update ---------------------------------------------------
+
+    def _update(self, root: bytes, key: bytes,
+                value: Optional[bytes]) -> bytes:
+        bits = _path_bits(key)
+        path_digest = _h(key)
+        # walk down, recording siblings
+        siblings: List[bytes] = []
+        node = root
+        for level in range(DEPTH):
+            if node == DEFAULTS[level]:
+                siblings.extend(DEFAULTS[l + 1] for l in range(level, DEPTH))
+                node = DEFAULTS[DEPTH]
+                break
+            raw = self._get_node(node)
+            left, right = raw[1:33], raw[33:65]
+            if bits[level] == 0:
+                siblings.append(right)
+                node = left
+            else:
+                siblings.append(left)
+                node = right
+        # new leaf
+        if value is None:
+            new = DEFAULTS[DEPTH]
+        else:
+            leaf_data = _LEAF_PREFIX + path_digest + value
+            new = self._put_node(leaf_data)
+        # walk back up
+        for level in range(DEPTH - 1, -1, -1):
+            sibling = siblings[level]
+            if bits[level] == 0:
+                data = _NODE_PREFIX + new + sibling
+            else:
+                data = _NODE_PREFIX + sibling + new
+            new = _h(data)
+            if new != DEFAULTS[level]:
+                self._dirty[b"n" + new] = data
+        return new
+
+    def _lookup(self, root: bytes, key: bytes) -> Optional[bytes]:
+        bits = _path_bits(key)
+        path_digest = _h(key)
+        node = root
+        for level in range(DEPTH):
+            if node == DEFAULTS[level]:
+                return None
+            raw = self._get_node(node)
+            left, right = raw[1:33], raw[33:65]
+            node = left if bits[level] == 0 else right
+        if node == DEFAULTS[DEPTH]:
+            return None
+        raw = self._get_node(node)
+        assert raw[:1] == _LEAF_PREFIX and raw[1:33] == path_digest
+        return raw[33:]
+
+    # --- State API -----------------------------------------------------
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._root = self._update(self._root, key, value)
+
+    def remove(self, key: bytes) -> None:
+        self._root = self._update(self._root, key, None)
+
+    def get(self, key: bytes, is_committed: bool = False) -> Optional[bytes]:
+        root = self._committed_root if is_committed else self._root
+        return self._lookup(root, key)
+
+    def get_for_root_hash(self, root: bytes, key: bytes) -> Optional[bytes]:
+        return self._lookup(root, key)
+
+    def commit(self, root_hash: Optional[bytes] = None) -> None:
+        self._committed_root = root_hash if root_hash is not None \
+            else self._root
+        self._root = self._committed_root
+        if self._dirty:
+            self._kv.do_batch(list(self._dirty.items()))
+            self._dirty.clear()
+        self._store_root()
+
+    def revert_to_head(self) -> None:
+        self._root = self._committed_root
+
+    @property
+    def head_hash(self) -> bytes:
+        return self._root
+
+    @property
+    def committed_head_hash(self) -> bytes:
+        return self._committed_root
+
+    # --- proofs --------------------------------------------------------
+
+    def generate_state_proof(self, key: bytes, root: Optional[bytes] = None,
+                             serialize: bool = True):
+        """Proof of (non-)membership: bitmap + non-default siblings.
+
+        Returns msgpack bytes when ``serialize`` (wire format for
+        state-proof replies), else the (bitmap, siblings) tuple.
+        """
+        root = root if root is not None else self._committed_root
+        bits = _path_bits(key)
+        siblings: List[bytes] = []
+        node = root
+        for level in range(DEPTH):
+            if node == DEFAULTS[level]:
+                siblings.extend(DEFAULTS[l + 1] for l in range(level, DEPTH))
+                break
+            raw = self._get_node(node)
+            left, right = raw[1:33], raw[33:65]
+            if bits[level] == 0:
+                siblings.append(right)
+                node = left
+            else:
+                siblings.append(left)
+                node = right
+        bitmap = bytearray(DEPTH // 8)
+        packed: List[bytes] = []
+        for level, sib in enumerate(siblings):
+            if sib != DEFAULTS[level + 1]:
+                bitmap[level // 8] |= 1 << (7 - level % 8)
+                packed.append(sib)
+        proof = (bytes(bitmap), packed)
+        if serialize:
+            return msgpack.packb([proof[0], proof[1]], use_bin_type=True)
+        return proof
+
+
+def verify_state_proof(root: bytes, key: bytes, value: Optional[bytes],
+                       proof) -> bool:
+    """Client-side scalar verification (host oracle for the device kernel)."""
+    if isinstance(proof, (bytes, bytearray)):
+        bitmap, packed = msgpack.unpackb(bytes(proof), raw=False)
+    else:
+        bitmap, packed = proof
+    bits = _path_bits(key)
+    path_digest = _h(key)
+    siblings = []
+    it = iter(packed)
+    for level in range(DEPTH):
+        if bitmap[level // 8] & (1 << (7 - level % 8)):
+            try:
+                siblings.append(next(it))
+            except StopIteration:
+                return False
+        else:
+            siblings.append(DEFAULTS[level + 1])
+    if value is None:
+        node = DEFAULTS[DEPTH]
+    else:
+        node = _h(_LEAF_PREFIX + path_digest + value)
+    for level in range(DEPTH - 1, -1, -1):
+        if bits[level] == 0:
+            node = _h(_NODE_PREFIX + node + siblings[level])
+        else:
+            node = _h(_NODE_PREFIX + siblings[level] + node)
+    return node == root
+
+
+# API-compat alias: the reference calls its concrete state PruningState
+PruningState = SparseMerkleState

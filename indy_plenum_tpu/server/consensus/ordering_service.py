@@ -28,6 +28,7 @@ from ...common.event_bus import ExternalBus, InternalBus
 from ...common.exceptions import SuspiciousNode
 from ...common.messages.internal_messages import (
     CheckpointStabilized,
+    MissingMessage,
     NewViewCheckpointsApplied,
     RaisedSuspicion,
     RequestPropagates,
@@ -149,7 +150,9 @@ class OrderingService:
                  requests: Optional[RequestsPool] = None,
                  bls=None,
                  config=None,
-                 get_time=None):
+                 get_time=None,
+                 vote_plane=None,
+                 shadow_check: bool = False):
         from ...config import getConfig
 
         self._data = data
@@ -162,6 +165,13 @@ class OrderingService:
         self._bls = bls or NoOpBlsBftReplica()
         self._config = config or getConfig()
         self._get_time = get_time or timer.get_current_time
+        # Device quorum plane (tpu.vote_plane.DeviceVotePlane). When set,
+        # prepare/commit certificates are DECIDED by the device tensors;
+        # the dicts below remain as message logs (MessageReq, duplicate
+        # detection). shadow_check additionally asserts dict-derived quorum
+        # == device verdict on every query (sim/test mode).
+        self._vote_plane = vote_plane
+        self._shadow_check = shadow_check
 
         # 3PC logs, keyed (view_no, pp_seq_no)
         self.sent_preprepares: Dict[Tuple[int, int], PrePrepare] = {}
@@ -259,6 +269,8 @@ class OrderingService:
         self.prePrepares[key] = pp
         self.batches[key] = ledger_id
         self._data.preprepare_batch(preprepare_to_batch_id(pp))
+        if self._vote_plane is not None:
+            self._vote_plane.record_preprepare(pp.ppSeqNo)
         self._network.send(pp)
         logger.debug("%s sent PRE-PREPARE %s (%d reqs)", self.name, key,
                      len(reqs))
@@ -354,6 +366,14 @@ class OrderingService:
         self.prePrepares[key] = pp
         self.batches[key] = pp.ledgerId
         self._data.preprepare_batch(preprepare_to_batch_id(pp))
+        if self._vote_plane is not None:
+            self._vote_plane.record_preprepare(pp.ppSeqNo)
+            # replay digest-matching PREPAREs that arrived before the
+            # PRE-PREPARE (they were logged but never scattered — only
+            # validated votes reach the device)
+            for s, p in self.prepares.get(key, {}).items():
+                if p.digest == pp.digest:
+                    self._vote_plane.record_prepare(s, pp.ppSeqNo)
         self._bls.process_pre_prepare(pp, sender)
 
         if not self._data.is_primary_in_view:
@@ -379,6 +399,8 @@ class OrderingService:
         )
         key = (pp.viewNo, pp.ppSeqNo)
         self.prepares.setdefault(key, {})[self.name] = prepare
+        if self._vote_plane is not None:
+            self._vote_plane.record_prepare(self.name, pp.ppSeqNo)
         self._network.send(prepare)
 
     def process_prepare(self, prepare: Prepare, sender: str):
@@ -399,14 +421,35 @@ class OrderingService:
             self._raise_suspicion(sender, Suspicions.PR_DIGEST_WRONG)
             return DISCARD, "PREPARE digest mismatch"
         votes[sender] = prepare
+        if self._vote_plane is not None and pp is not None:
+            # pp present => digest checked above; safe to scatter
+            self._vote_plane.record_prepare(sender, prepare.ppSeqNo)
         self._bls.process_prepare(prepare, sender)
         self._try_prepared(key)
         return PROCESS
 
-    def _has_prepare_quorum(self, key: Tuple[int, int]) -> bool:
+    def _dict_prepare_quorum(self, key: Tuple[int, int]) -> bool:
+        # Only votes whose digest matches the accepted PRE-PREPARE count:
+        # PREPAREs can arrive before the PRE-PREPARE (and are recorded), so
+        # a byzantine node must not be able to inflate the certificate with
+        # arbitrary-digest early votes.
+        pp = self.prePrepares.get(key)
+        if pp is None:
+            return False
         votes = self.prepares.get(key, {})
-        others = [s for s in votes if s != self._data.primary_name]
+        others = [s for s, p in votes.items()
+                  if s != self._data.primary_name and p.digest == pp.digest]
         return self._data.quorums.prepare.is_reached(len(others))
+
+    def _has_prepare_quorum(self, key: Tuple[int, int]) -> bool:
+        if self._vote_plane is None:
+            return self._dict_prepare_quorum(key)
+        dev = (key[0] == self._data.view_no
+               and self._vote_plane.has_prepare_quorum(key[1]))
+        if self._shadow_check:
+            host = self._dict_prepare_quorum(key)
+            assert dev == host, ("prepare quorum divergence", key, dev, host)
+        return dev
 
     def _try_prepared(self, key: Tuple[int, int]) -> None:
         pp = self.prePrepares.get(key)
@@ -426,6 +469,8 @@ class OrderingService:
         params = self._bls.update_commit(params, pp)
         commit = Commit(**params)
         self.commits.setdefault(key, {})[self.name] = commit
+        if self._vote_plane is not None:
+            self._vote_plane.record_commit(self.name, pp.ppSeqNo)
         self._network.send(commit)
         self._try_order(key)
 
@@ -445,6 +490,8 @@ class OrderingService:
             self._bus.send(RaisedSuspicion(self._data.inst_id, ex))
             return DISCARD, "bad BLS sig in COMMIT"
         votes[sender] = commit
+        if self._vote_plane is not None:
+            self._vote_plane.record_commit(sender, commit.ppSeqNo)
         self._bls.process_commit(commit, sender)
         self._try_order(key)
         return PROCESS
@@ -453,9 +500,19 @@ class OrderingService:
     # ordering
     # ------------------------------------------------------------------
 
-    def _has_commit_quorum(self, key: Tuple[int, int]) -> bool:
+    def _dict_commit_quorum(self, key: Tuple[int, int]) -> bool:
         return self._data.quorums.commit.is_reached(
             len(self.commits.get(key, {})))
+
+    def _has_commit_quorum(self, key: Tuple[int, int]) -> bool:
+        if self._vote_plane is None:
+            return self._dict_commit_quorum(key)
+        dev = (key[0] == self._data.view_no
+               and self._vote_plane.has_commit_quorum(key[1]))
+        if self._shadow_check:
+            host = self._dict_commit_quorum(key)
+            assert dev == host, ("commit quorum divergence", key, dev, host)
+        return dev
 
     def _can_order(self, key: Tuple[int, int]) -> bool:
         pp = self.prePrepares.get(key)
@@ -527,6 +584,9 @@ class OrderingService:
             orig = pp.originalViewNo if pp.originalViewNo is not None \
                 else pp.viewNo
             self.old_view_preprepares[(orig, pp.ppSeqNo, pp.digest)] = pp
+        if self._vote_plane is not None:
+            # old-view votes are void; slots refill during re-ordering
+            self._vote_plane.reset(h=self._data.low_watermark)
         self.sent_preprepares.clear()
         self.prePrepares.clear()
         self.prepares.clear()
@@ -551,8 +611,17 @@ class OrderingService:
             old_pp = self.old_view_preprepares.get(
                 (pp_view_no, pp_seq_no, digest))
             if old_pp is None:
-                logger.warning("%s missing old PrePrepare for %s",
+                # liveness: with strict in-order ordering, a hole here would
+                # stall everything at/past this seqNo. The new primary holds
+                # (and re-broadcasts) the batch under its new-view key; ask
+                # for it explicitly in case the broadcast is lost.
+                logger.warning("%s missing old PrePrepare for %s, requesting",
                                self.name, bid)
+                self._bus.send(MissingMessage(
+                    msg_type="PREPREPARE",
+                    key=(msg.view_no, pp_seq_no),
+                    inst_id=self._data.inst_id,
+                    dst=None))
                 continue
             params = old_pp._fields
             params.update(viewNo=msg.view_no,
@@ -565,6 +634,8 @@ class OrderingService:
                 self.prePrepares[key] = new_pp
                 self.batches[key] = new_pp.ledgerId
                 self._data.preprepare_batch(preprepare_to_batch_id(new_pp))
+                if self._vote_plane is not None:
+                    self._vote_plane.record_preprepare(new_pp.ppSeqNo)
                 self._network.send(new_pp)
                 self._try_prepared(key)
             else:
@@ -586,6 +657,8 @@ class OrderingService:
         self.old_view_preprepares = {
             k: v for k, v in self.old_view_preprepares.items()
             if k[1] > stable_seq}
+        if self._vote_plane is not None:
+            self._vote_plane.slide_to(stable_seq)
         self._bls.gc(msg.last_stable_3pc)
         self._stasher.process_stashed(STASH_WATERMARKS)
 

@@ -60,8 +60,13 @@ def calc_checkpoint(view_changes: List[ViewChange],
     """Highest checkpoint supported by >= f+1 VIEW_CHANGEs."""
     counts: Dict[CheckpointValue, int] = {}
     for vc in view_changes:
-        for cp in vc.checkpoints:
-            counts[tuple(cp)] = counts.get(tuple(cp), 0) + 1
+        # dedup within each VIEW_CHANGE: one sender contributes at most one
+        # vote per checkpoint value (else a single byzantine VC listing the
+        # same checkpoint f+1 times fabricates weak-quorum support alone).
+        # Order-preserving dedup: set iteration is hash-seed-dependent and
+        # every replica must compute identical results.
+        for cp in dict.fromkeys(map(tuple, vc.checkpoints)):
+            counts[cp] = counts.get(cp, 0) + 1
     supported = [cp for cp, cnt in counts.items()
                  if quorums.weak.is_reached(cnt)]
     if not supported:
@@ -79,13 +84,15 @@ def calc_batches(checkpoint: CheckpointValue,
     preprepared_by_seq: Dict[int, Dict[str, int]] = {}
     batch_info: Dict[Tuple[int, str], list] = {}
     for vc in view_changes:
-        for b in vc.prepared:
+        # dedup within each VIEW_CHANGE (one vote per sender per batch id);
+        # order-preserving: replicas must agree on batch_info tie-breaks
+        for b in dict.fromkeys(map(tuple, vc.prepared)):
             _, pp_view, seq, digest = b
             prepared_by_seq.setdefault(seq, {})
             prepared_by_seq[seq][digest] = \
                 prepared_by_seq[seq].get(digest, 0) + 1
             batch_info.setdefault((seq, digest), list(b))
-        for b in vc.preprepared:
+        for b in dict.fromkeys(map(tuple, vc.preprepared)):
             _, pp_view, seq, digest = b
             preprepared_by_seq.setdefault(seq, {})
             preprepared_by_seq[seq][digest] = \
@@ -146,7 +153,7 @@ class ViewChangeService:
     def process_need_view_change(self, msg: NodeNeedViewChange) -> None:
         proposed = msg.view_no if msg.view_no is not None \
             else self._data.view_no + 1
-        if proposed <= self._data.view_no and self._data.view_no != 0:
+        if proposed <= self._data.view_no:
             return
         self.start_view_change(proposed)
 

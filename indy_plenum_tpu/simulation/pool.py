@@ -141,7 +141,7 @@ class SimNode:
 
     def __init__(self, name: str, validators: List[str], timer: MockTimer,
                  network: SimNetwork, requests: SimRequestsPool,
-                 config: Config):
+                 config: Config, device_quorum: bool = False):
         self.name = name
         self.config = config
         self.data = ConsensusSharedData(
@@ -157,11 +157,19 @@ class SimNode:
         self.executor = SimExecutor()
         self.requests_view = requests.view_for(name)
 
+        self.vote_plane = None
+        if device_quorum:
+            from ..tpu.vote_plane import DeviceVotePlane
+
+            self.vote_plane = DeviceVotePlane(
+                validators, log_size=config.LOG_SIZE)
+
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher,
             executor=self.executor, requests=self.requests_view,
-            config=config)
+            config=config, vote_plane=self.vote_plane,
+            shadow_check=device_quorum)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher, config=config)
@@ -206,7 +214,8 @@ class SimNode:
 
 class SimPool:
     def __init__(self, n_nodes: int = 4, seed: int = 0,
-                 config: Optional[Config] = None):
+                 config: Optional[Config] = None,
+                 device_quorum: bool = False):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -217,7 +226,7 @@ class SimPool:
             self.requests.register_node(name)
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
-                    self.requests, self.config)
+                    self.requests, self.config, device_quorum=device_quorum)
             for name in self.validators]
         self.network.connect_all()
 

@@ -22,6 +22,14 @@ window and resets slot columns on checkpoint stabilization.
 Quorum thresholds (reference ``plenum/server/quorums.py``): f = (n-1)//3;
 prepare quorum = n-f-1 (excludes the primary, which doesn't send PREPARE);
 commit/checkpoint quorum = n-f.
+
+**Vote-inclusion contract:** thresholds count votes over the FULL validator
+axis, so the packer MUST scatter this node's OWN votes (its PREPARE row, its
+COMMIT, its CHECKPOINT) into the batch alongside received messages. The host
+services see only received messages (host ``Quorums.checkpoint`` is n-f-1 of
+*others*); the device plane's n-f checkpoint threshold is equivalent only
+when the own vote is present. ``pack_messages`` takes (kind, sender, slot)
+triples — include ``(CHECKPOINT, own_index, slot)`` etc. explicitly.
 """
 from __future__ import annotations
 
@@ -201,7 +209,12 @@ def make_sharded_step(mesh: Mesh, n_validators: int, axis: str = "validators"):
 def pack_messages(
     entries, max_batch: int
 ) -> MsgBatch:
-    """Host helper: list of (kind, sender, slot) -> padded device MsgBatch."""
+    """Host helper: list of (kind, sender, slot) -> padded device MsgBatch.
+
+    Entries must include this node's OWN votes, not just received messages
+    (see the module docstring's vote-inclusion contract) — quorum thresholds
+    are over the full validator axis.
+    """
     m = len(entries)
     assert m <= max_batch
     kind = np.zeros(max_batch, np.int32)

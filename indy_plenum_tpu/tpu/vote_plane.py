@@ -1,0 +1,183 @@
+"""Host adapter making the device quorum tensors the consensus truth source.
+
+Reference analog: the per-message Python tallies in
+``plenum/server/consensus/ordering_service.py`` (prepare/commit cert
+collection). Here the :class:`OrderingService` delegates quorum detection to
+this plane: validated votes are buffered on the host, scattered into the
+dense (validator x slot) tensors of :mod:`indy_plenum_tpu.tpu.quorum` in
+fixed-size batches (stable shapes => one XLA compilation), and quorum
+verdicts are read back as boolean events. The Python dicts remain only as
+message logs (MessageReq replies, duplicate detection) — decisions come
+from :class:`~indy_plenum_tpu.tpu.quorum.QuorumEvents`.
+
+Slot addressing is watermark-relative (slot = pp_seq_no - h - 1), mirroring
+the reference's h/H window; ``slide_to`` rolls the window on checkpoint
+stabilization and ``reset`` clears it on view change.
+
+Per the vote-inclusion contract in :mod:`indy_plenum_tpu.tpu.quorum`, the
+caller records its OWN votes too, not just received messages.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import quorum as q
+
+# fixed flush granularity: stable shapes keep XLA from recompiling
+FLUSH_BATCH = 128
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _step(state: q.VoteState, msgs: q.MsgBatch, n_validators: int):
+    return q.step(state, msgs, n_validators)
+
+
+@jax.jit
+def _slide(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
+    """Roll the slot axis left by ``delta`` and zero the vacated columns."""
+    s = state.prepare_votes.shape[1]
+    cols = jnp.arange(s)
+    keep = cols < (s - delta)  # after roll, tail columns are new/empty
+
+    def roll1(x):
+        return jnp.where(keep, jnp.roll(x, -delta), 0)
+
+    def roll2(x):
+        return jnp.where(keep[None, :], jnp.roll(x, -delta, axis=1), 0)
+
+    return q.VoteState(
+        preprepare_seen=roll1(state.preprepare_seen),
+        prepare_votes=roll2(state.prepare_votes),
+        commit_votes=roll2(state.commit_votes),
+        checkpoint_votes=jnp.zeros_like(state.checkpoint_votes),
+        ordered=roll1(state.ordered),
+    )
+
+
+class DeviceVotePlane:
+    """Per-instance device vote tensors + lazy flush/query interface."""
+
+    def __init__(self, validators: List[str], log_size: int,
+                 n_checkpoints: int = 4, h: int = 0):
+        self._validators = list(validators)
+        self._index = {name: i for i, name in enumerate(self._validators)}
+        self._n = len(self._validators)
+        self._log_size = log_size
+        self._n_chk = n_checkpoints
+        self._h = h
+        self._state = q.init_state(self._n, log_size, n_checkpoints)
+        self._pending: List[tuple] = []  # (kind, sender_idx, slot)
+        self._events: Optional[q.QuorumEvents] = None
+        # host copies of the event arrays, refreshed once per flush (quorum
+        # queries are per-message; don't re-transfer per query)
+        self._host_prepared: Optional[np.ndarray] = None
+        self._host_prepare_counts: Optional[np.ndarray] = None
+        self._host_commit_counts: Optional[np.ndarray] = None
+        self.flushes = 0
+
+    # --- recording ------------------------------------------------------
+
+    @property
+    def h(self) -> int:
+        return self._h
+
+    def _slot(self, pp_seq_no: int) -> Optional[int]:
+        slot = pp_seq_no - self._h - 1
+        if 0 <= slot < self._log_size:
+            return slot
+        return None
+
+    def _record(self, kind: int, sender: Optional[str],
+                pp_seq_no: int) -> None:
+        slot = self._slot(pp_seq_no)
+        if slot is None:
+            return
+        idx = 0 if sender is None else self._index.get(sender)
+        if idx is None:
+            return
+        self._pending.append((kind, idx, slot))
+        self._events = None
+
+    def record_preprepare(self, pp_seq_no: int) -> None:
+        self._record(q.PREPREPARE, None, pp_seq_no)
+
+    def record_prepare(self, sender: str, pp_seq_no: int) -> None:
+        self._record(q.PREPARE, sender, pp_seq_no)
+
+    def record_commit(self, sender: str, pp_seq_no: int) -> None:
+        self._record(q.COMMIT, sender, pp_seq_no)
+
+    def record_checkpoint(self, sender: str, chk_slot: int) -> None:
+        if 0 <= chk_slot < self._n_chk and sender in self._index:
+            self._pending.append((q.CHECKPOINT, self._index[sender], chk_slot))
+            self._events = None
+
+    # --- window management ---------------------------------------------
+
+    def slide_to(self, new_h: int) -> None:
+        """Checkpoint stabilized at ``new_h``: drop slots <= new_h."""
+        if new_h <= self._h:
+            return
+        self._flush()
+        self._state = _slide(self._state, jnp.int32(new_h - self._h))
+        self._h = new_h
+        self._events = None
+
+    def reset(self, h: Optional[int] = None) -> None:
+        """View change: clear all votes (they were for the old view)."""
+        if h is not None:
+            self._h = h
+        self._state = q.init_state(self._n, self._log_size, self._n_chk)
+        self._pending.clear()
+        self._events = None
+
+    # --- flush + queries ------------------------------------------------
+
+    def _flush(self) -> None:
+        while self._pending:
+            chunk, self._pending = (self._pending[:FLUSH_BATCH],
+                                    self._pending[FLUSH_BATCH:])
+            msgs = q.pack_messages(chunk, FLUSH_BATCH)
+            self._state, self._events = _step(self._state, msgs, self._n)
+            self.flushes += 1
+
+    def events(self) -> q.QuorumEvents:
+        if self._pending or self._events is None:
+            self._flush()
+            if self._events is None:  # nothing ever recorded
+                self._state, self._events = _step(
+                    self._state, q.pack_messages([], FLUSH_BATCH), self._n)
+            self._host_prepared = np.asarray(self._events.prepared)
+            self._host_prepare_counts = np.asarray(
+                self._events.prepare_counts)
+            self._host_commit_counts = np.asarray(self._events.commit_counts)
+        return self._events
+
+    def has_prepare_quorum(self, pp_seq_no: int) -> bool:
+        """PRE-PREPARE seen AND n-f-1 matching PREPAREs (device verdict)."""
+        slot = self._slot(pp_seq_no)
+        if slot is None:
+            return False
+        self.events()
+        return bool(self._host_prepared[slot])
+
+    def has_commit_quorum(self, pp_seq_no: int) -> bool:
+        slot = self._slot(pp_seq_no)
+        if slot is None:
+            return False
+        self.events()
+        f = (self._n - 1) // 3
+        return int(self._host_commit_counts[slot]) >= self._n - f
+
+    def prepare_count(self, pp_seq_no: int) -> int:
+        slot = self._slot(pp_seq_no)
+        if slot is None:
+            return 0
+        self.events()
+        return int(self._host_prepare_counts[slot])

@@ -1,0 +1,47 @@
+"""Tier-6-style byzantine regressions for the advisor's round-1 findings.
+
+A forged early PREPARE (sent before the PRE-PREPARE, with an arbitrary
+digest) must never count toward the prepare certificate; only votes whose
+digest matches the accepted PRE-PREPARE do.
+"""
+from indy_plenum_tpu.common.messages.node_messages import Prepare
+from indy_plenum_tpu.simulation.pool import SimPool
+from indy_plenum_tpu.simulation.sim_network import delay_message_types
+
+
+def test_early_prepare_with_bogus_digest_does_not_count():
+    pool = SimPool(4, seed=11)  # n=4, f=1: prepare quorum = 2 non-primary votes
+    node1 = pool.node("node1")
+
+    # node3 (the byzantine one) sends an early PREPARE with a forged digest
+    # before any PRE-PREPARE exists for (view 0, seq 1)
+    evil = Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=1_700_000_000, digest="evil",
+                   stateRootHash=None, txnRootHash=None)
+    node1.external_bus.process_incoming(evil, "node3")
+
+    # hold back honest PREPAREs to node1: without digest filtering, node1's
+    # own vote + the forged one reach the 2-vote threshold prematurely
+    pool.network.add_delayer(
+        delay_message_types(Prepare, to="node1", seconds=3.0))
+    pool.submit_request(0)
+    pool.run_for(2)
+    assert not node1.data.prepared, "forged early vote inflated the cert"
+    assert not node1.ordered_digests
+
+    # once an honest PREPARE (matching digest) arrives, the cert completes
+    pool.run_for(8)
+    assert len(node1.ordered_digests) == 1
+    assert pool.honest_nodes_agree()
+
+
+def test_byzantine_wrong_digest_prepare_cannot_block_honest_quorum():
+    # the evil vote squats node3's slot but honest n-f-1 others still prepare
+    pool = SimPool(4, seed=12)
+    node1 = pool.node("node1")
+    evil = Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=1_700_000_000, digest="evil",
+                   stateRootHash=None, txnRootHash=None)
+    node1.external_bus.process_incoming(evil, "node3")
+    pool.submit_request(0)
+    pool.run_for(10)
+    assert len(node1.ordered_digests) == 1
+    assert pool.honest_nodes_agree()

@@ -1,0 +1,78 @@
+"""Device quorum plane drives the consensus path (VERDICT round-1 item 2).
+
+SimPool(device_quorum=True) wires a DeviceVotePlane into every node's
+OrderingService: prepare/commit certificates are decided by the dense
+device vote tensors (tpu.quorum.QuorumEvents), with shadow_check asserting
+dict-derived quorum == device verdict on every query. These tests prove the
+ordering decisions come from the device plane, across the full protocol:
+ordering, checkpoints/watermark slides, and view change resets.
+"""
+import pytest
+
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.simulation.pool import SimPool
+
+
+def test_device_plane_orders_4_nodes():
+    pool = SimPool(4, seed=21, device_quorum=True)
+    for i in range(8):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert len(node.ordered_digests) == 8, node.name
+        # decisions demonstrably came from the device: the plane flushed
+        # vote batches and its verdicts were returned (shadow_check would
+        # have raised on any divergence from the dict tallies)
+        assert node.vote_plane is not None
+        assert node.vote_plane.flushes > 0, node.name
+
+
+def test_device_plane_matches_host_only_run():
+    def digests(device):
+        pool = SimPool(4, seed=22, device_quorum=device)
+        for i in range(6):
+            pool.submit_request(i)
+        pool.run_for(8)
+        return [tuple(n.ordered_digests) for n in pool.nodes]
+
+    assert digests(True) == digests(False)
+
+
+def test_device_plane_watermark_slide():
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 1,
+                     "CHK_FREQ": 5, "LOG_SIZE": 15})
+    pool = SimPool(4, seed=23, config=cfg, device_quorum=True)
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(20)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert node.data.last_ordered_3pc[1] >= 12
+        assert node.data.stable_checkpoint >= 10
+        # the plane's window slid with the stable checkpoint
+        assert node.vote_plane.h == node.data.low_watermark
+
+
+def test_device_plane_survives_view_change():
+    pool = SimPool(4, seed=24, device_quorum=True)
+    primary_name = pool.nodes[0].data.primaries[0]
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(5)
+    assert all(len(n.ordered_digests) == 4 for n in pool.nodes)
+
+    pool.network.disconnect(primary_name)
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 8)
+
+    survivors = [n for n in pool.nodes if n.name != primary_name]
+    for node in survivors:
+        assert node.data.view_no >= 1
+        assert not node.data.waiting_for_new_view
+
+    for i in range(100, 105):
+        pool.submit_request(i)
+    pool.run_for(10)
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+    assert len(logs[0]) == 9

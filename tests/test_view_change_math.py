@@ -79,6 +79,18 @@ def test_at_most_one_batch_per_seqno():
     assert len(got) == 1  # deterministic pick, never both
 
 
+def test_duplicate_entries_in_one_vc_do_not_fabricate_quorum():
+    # A single byzantine VIEW_CHANGE repeating a bogus checkpoint f+1 times
+    # must contribute only ONE vote for it (dedup per sender).
+    vcs = [vc(checkpoints=[(0, 999, "bogus"), (0, 999, "bogus")]),
+           vc(), vc()]
+    assert calc_checkpoint(vcs, Q4) == (0, 0, "stable")
+    # same for batch preprepare support
+    b = (1, 0, 5, "d")
+    vcs = [vc(prepared=[b], preprepared=[b, b]), vc(), vc()]
+    assert calc_batches((0, 0, "stable"), vcs, Q4) == []
+
+
 def test_view_change_digest_stable():
     v1 = vc(prepared=[(1, 0, 1, "x")])
     v2 = vc(prepared=[(1, 0, 1, "x")])

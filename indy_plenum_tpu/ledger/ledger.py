@@ -24,8 +24,10 @@ class Ledger:
                  tree: Optional[CompactMerkleTree] = None,
                  txn_store: Optional[KeyValueStorage] = None,
                  serializer=ledger_txn_serializer):
-        self.tree = tree or CompactMerkleTree()
-        self.txn_store = txn_store or KeyValueStorageInMemory()
+        # NOT `tree or ...`: an empty CompactMerkleTree is falsy (__len__)
+        self.tree = tree if tree is not None else CompactMerkleTree()
+        self.txn_store = txn_store if txn_store is not None \
+            else KeyValueStorageInMemory()
         self.serializer = serializer
         self._uncommitted: List[Dict[str, Any]] = []
         self.seq_no = self.tree.tree_size  # committed height (1-based last)

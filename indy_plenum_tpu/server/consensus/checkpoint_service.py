@@ -39,7 +39,9 @@ class CheckpointService:
                  bus: InternalBus,
                  network: ExternalBus,
                  stasher: StashingRouter,
-                 config=None):
+                 config=None,
+                 vote_plane=None,
+                 shadow_check: bool = False):
         from ...config import getConfig
 
         self._data = data
@@ -47,6 +49,12 @@ class CheckpointService:
         self._network = network
         self._stasher = stasher
         self._config = config or getConfig()
+        # device checkpoint tally (tpu.vote_plane). Only digest-matching
+        # votes are scattered (the tensor is digest-blind), own vote
+        # included per the vote-inclusion contract: device n-f == host
+        # n-f-1 others + own.
+        self._vote_plane = vote_plane
+        self._shadow_check = shadow_check
 
         # digests of ordered batches since the last checkpoint boundary
         self._digests_since: list[str] = []
@@ -85,6 +93,15 @@ class CheckpointService:
         )
         self._own_checkpoints[seq_no_end] = cp
         logger.debug("%s checkpoint at %d", self._data.name, seq_no_end)
+        if self._vote_plane is not None:
+            self._vote_plane.record_checkpoint_vote(
+                self._data.name, seq_no_end, self._chk_freq)
+            # replay received votes that arrived before our own checkpoint
+            # existed (only now can their digests be validated)
+            key = (view_no, seq_no_end, cp.digest)
+            for sender in self._received.get(key, ()):
+                self._vote_plane.record_checkpoint_vote(
+                    sender, seq_no_end, self._chk_freq)
         self._network.send(cp)
         self._try_stabilize(view_no, seq_no_end)
 
@@ -95,17 +112,35 @@ class CheckpointService:
             return DISCARD, "already stable"
         key: CheckpointKey = (cp.viewNo, cp.seqNoEnd, cp.digest)
         self._received.setdefault(key, set()).add(sender)
+        if self._vote_plane is not None:
+            own = self._own_checkpoints.get(cp.seqNoEnd)
+            if own is not None and own.viewNo == cp.viewNo \
+                    and own.digest == cp.digest:
+                self._vote_plane.record_checkpoint_vote(
+                    sender, cp.seqNoEnd, self._chk_freq)
         self._check_lag(cp.viewNo, cp.seqNoEnd)
         self._try_stabilize(cp.viewNo, cp.seqNoEnd)
         return PROCESS
+
+    def _has_quorum(self, view_no: int, seq_no_end: int, digest: str) -> bool:
+        key: CheckpointKey = (view_no, seq_no_end, digest)
+        host = self._data.quorums.checkpoint.is_reached(
+            len(self._received.get(key, set())))
+        if self._vote_plane is None:
+            return host
+        dev = (view_no == self._data.view_no
+               and self._vote_plane.has_checkpoint_quorum(
+                   seq_no_end, self._chk_freq))
+        if self._shadow_check:
+            assert dev == host, (
+                "checkpoint quorum divergence", key, dev, host)
+        return dev
 
     def _try_stabilize(self, view_no: int, seq_no_end: int) -> None:
         own = self._own_checkpoints.get(seq_no_end)
         if own is None or own.viewNo != view_no:
             return
-        key: CheckpointKey = (view_no, seq_no_end, own.digest)
-        votes = self._received.get(key, set())
-        if not self._data.quorums.checkpoint.is_reached(len(votes)):
+        if not self._has_quorum(view_no, seq_no_end, own.digest):
             # byzantine check: quorum formed on a DIFFERENT digest for the
             # same seqNoEnd means we diverged
             for (v, s, d), senders in self._received.items():
@@ -130,6 +165,19 @@ class CheckpointService:
         self._bus.send(CheckpointStabilized(
             inst_id=self._data.inst_id,
             last_stable_3pc=(view_no, seq_no_end)))
+        if self._vote_plane is not None:
+            # the bus dispatch above slid the plane's window (zeroing all
+            # checkpoint columns); re-scatter the surviving votes for
+            # boundaries above the new stable point
+            for seq, own in self._own_checkpoints.items():
+                if own.viewNo != self._data.view_no:
+                    continue
+                self._vote_plane.record_checkpoint_vote(
+                    self._data.name, seq, self._chk_freq)
+                key = (own.viewNo, seq, own.digest)
+                for sender in self._received.get(key, ()):
+                    self._vote_plane.record_checkpoint_vote(
+                        sender, seq, self._chk_freq)
 
     def _check_lag(self, view_no: int, seq_no_end: int) -> None:
         """f+1 distinct nodes checkpointing beyond our H => we are behind."""

@@ -30,6 +30,7 @@ PREPREPARE = "PREPREPARE"
 PREPARE = "PREPARE"
 COMMIT = "COMMIT"
 VIEW_CHANGE = "VIEW_CHANGE"
+OLD_VIEW_PREPREPARE = "OLD_VIEW_PREPREPARE"
 
 
 class MessageReqService:
@@ -72,6 +73,12 @@ class MessageReqService:
         elif msg.msg_type == VIEW_CHANGE:
             sender, digest = msg.key
             params = {"sender": sender, "digest": digest}
+        elif msg.msg_type == OLD_VIEW_PREPREPARE:
+            # broadcast: ANY node that prepared the batch holds it; the
+            # digest in the key authenticates whatever comes back
+            orig_view, pp_seq_no, digest = msg.key
+            params = {"originalViewNo": orig_view, "ppSeqNo": pp_seq_no,
+                      "digest": digest}
         else:
             return
         self._outstanding.add((msg.msg_type, self._params_key(params)))
@@ -90,6 +97,7 @@ class MessageReqService:
             PREPARE: self._find_prepare,
             COMMIT: self._find_commit,
             VIEW_CHANGE: self._find_view_change,
+            OLD_VIEW_PREPREPARE: self._find_old_view_preprepare,
         }.get(req.msg_type)
         if handler is None:
             return DISCARD, f"unknown msg_type {req.msg_type}"
@@ -127,6 +135,24 @@ class MessageReqService:
         votes = self._ordering.commits.get(key, {})
         return votes.get(self._data.name)
 
+    def _find_old_view_preprepare(self, params):
+        if self._ordering is None:
+            return None
+        try:
+            key = (int(params["originalViewNo"]), int(params["ppSeqNo"]),
+                   str(params["digest"]))
+        except (KeyError, ValueError, TypeError):
+            return None
+        found = self._ordering.old_view_preprepares.get(key)
+        if found is None:
+            # the batch may still be live in the current-view log
+            for pp in self._ordering.prePrepares.values():
+                orig = pp.originalViewNo if pp.originalViewNo is not None \
+                    else pp.viewNo
+                if (orig, pp.ppSeqNo, pp.digest) == key:
+                    return pp
+        return found
+
     def _find_view_change(self, params):
         if self._view_change is None:
             return None
@@ -138,6 +164,12 @@ class MessageReqService:
         if vc is not None and view_change_digest(vc) == digest:
             return vc
         return None
+
+    @staticmethod
+    def _batch_digest_of(pp: PrePrepare) -> str:
+        from .ordering_service import OrderingService
+
+        return OrderingService._batch_digest(list(pp.reqIdr))
 
     # --- inbound responses ---------------------------------------------
 
@@ -152,9 +184,26 @@ class MessageReqService:
         except Exception as exc:  # noqa: BLE001 - wire data is untrusted
             return DISCARD, f"bad payload: {exc}"
         expected = {PREPREPARE: PrePrepare, PREPARE: Prepare,
-                    COMMIT: Commit, VIEW_CHANGE: ViewChange}.get(rep.msg_type)
+                    COMMIT: Commit, VIEW_CHANGE: ViewChange,
+                    OLD_VIEW_PREPREPARE: PrePrepare}.get(rep.msg_type)
         if expected is None or not isinstance(msg, expected):
             return DISCARD, "payload type mismatch"
+        if rep.msg_type == OLD_VIEW_PREPREPARE:
+            # content is authenticated by the digest we asked for (it came
+            # out of NEW_VIEW's weak-quorum-supported batch id)
+            orig = msg.originalViewNo if msg.originalViewNo is not None \
+                else msg.viewNo
+            want = rep.params
+            if (str(msg.digest) != str(want.get("digest"))
+                    or int(orig) != int(want.get("originalViewNo", -1))
+                    or int(msg.ppSeqNo) != int(want.get("ppSeqNo", -1))):
+                return DISCARD, "old-view PRE-PREPARE mismatch"
+            if msg.digest != self._batch_digest_of(msg):
+                return DISCARD, "old-view PRE-PREPARE digest forged"
+            self._outstanding.discard(key)
+            if self._ordering is not None:
+                self._ordering.process_requested_old_view_pp(msg)
+            return PROCESS
         if isinstance(msg, PrePrepare):
             # Requests for PRE-PREPAREs only go to the primary (see
             # process_missing_message), so the relayer IS the claimed

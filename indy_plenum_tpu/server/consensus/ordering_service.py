@@ -183,6 +183,9 @@ class OrderingService:
         self.requested_pre_prepares: set = set()
         # PrePrepares retained across a view change for re-ordering
         self.old_view_preprepares: Dict[Tuple[int, int, str], PrePrepare] = {}
+        # NEW_VIEW batches whose old PrePrepare we lack, awaiting fetch:
+        # (orig_view, pp_seq_no, digest) -> new view_no
+        self._pending_old_view_bids: Dict[Tuple[int, int, str], int] = {}
         # highest seq speculatively applied (or committed) — the in-order
         # apply guard for non-primary re-application
         self._last_applied_seq = 0
@@ -587,6 +590,7 @@ class OrderingService:
         if self._vote_plane is not None:
             # old-view votes are void; slots refill during re-ordering
             self._vote_plane.reset(h=self._data.low_watermark)
+        self._pending_old_view_bids.clear()
         self.sent_preprepares.clear()
         self.prePrepares.clear()
         self.prepares.clear()
@@ -608,40 +612,61 @@ class OrderingService:
         self._data.clear_batches()
         for bid in msg.batches:
             view_no, pp_view_no, pp_seq_no, digest = bid
-            old_pp = self.old_view_preprepares.get(
-                (pp_view_no, pp_seq_no, digest))
+            old_key = (pp_view_no, pp_seq_no, digest)
+            old_pp = self.old_view_preprepares.get(old_key)
             if old_pp is None:
                 # liveness: with strict in-order ordering, a hole here would
-                # stall everything at/past this seqNo. The new primary holds
-                # (and re-broadcasts) the batch under its new-view key; ask
-                # for it explicitly in case the broadcast is lost.
+                # stall everything at/past this seqNo. ANY node that listed
+                # the batch in its VIEW_CHANGE holds the old PrePrepare (the
+                # new primary may itself lack it), so fetch it from the pool
+                # — the digest in the batch id authenticates the content.
                 logger.warning("%s missing old PrePrepare for %s, requesting",
                                self.name, bid)
+                self._pending_old_view_bids[old_key] = msg.view_no
                 self._bus.send(MissingMessage(
-                    msg_type="PREPREPARE",
-                    key=(msg.view_no, pp_seq_no),
+                    msg_type="OLD_VIEW_PREPREPARE",
+                    key=old_key,
                     inst_id=self._data.inst_id,
                     dst=None))
                 continue
-            params = old_pp._fields
-            params.update(viewNo=msg.view_no,
-                          originalViewNo=pp_view_no)
-            new_pp = PrePrepare(**params)
-            self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
-            if self._data.is_primary_in_view:
-                key = (new_pp.viewNo, new_pp.ppSeqNo)
-                self.sent_preprepares[key] = new_pp
-                self.prePrepares[key] = new_pp
-                self.batches[key] = new_pp.ledgerId
-                self._data.preprepare_batch(preprepare_to_batch_id(new_pp))
-                if self._vote_plane is not None:
-                    self._vote_plane.record_preprepare(new_pp.ppSeqNo)
-                self._network.send(new_pp)
-                self._try_prepared(key)
-            else:
-                # process as if received from the new primary
-                self.process_preprepare(new_pp, self._data.primary_name)
+            self._apply_new_view_batch(old_pp, msg.view_no, pp_view_no)
         self._stasher.process_all_stashed()
+
+    def _apply_new_view_batch(self, old_pp: PrePrepare, new_view_no: int,
+                              orig_view_no: int) -> None:
+        """Re-key one NEW_VIEW-selected batch into the new view and process
+        it (primary: re-broadcast; replica: run the normal PP path)."""
+        params = old_pp._fields
+        params.update(viewNo=new_view_no, originalViewNo=orig_view_no)
+        new_pp = PrePrepare(**params)
+        self._data.pp_seq_no = max(self._data.pp_seq_no, new_pp.ppSeqNo)
+        if self._data.is_primary_in_view:
+            key = (new_pp.viewNo, new_pp.ppSeqNo)
+            self.sent_preprepares[key] = new_pp
+            self.prePrepares[key] = new_pp
+            self.batches[key] = new_pp.ledgerId
+            self._data.preprepare_batch(preprepare_to_batch_id(new_pp))
+            if self._vote_plane is not None:
+                self._vote_plane.record_preprepare(new_pp.ppSeqNo)
+            self._network.send(new_pp)
+            self._try_prepared(key)
+        else:
+            # through the stasher: out-of-order/early verdicts must stash,
+            # not vanish (a direct handler call would drop the verdict)
+            self._stasher.process(new_pp, self._data.primary_name)
+
+    def process_requested_old_view_pp(self, pp: PrePrepare) -> None:
+        """A fetched old-view PrePrepare arrived (MessageReqService validated
+        the digest against what we asked for)."""
+        orig = pp.originalViewNo if pp.originalViewNo is not None \
+            else pp.viewNo
+        old_key = (orig, pp.ppSeqNo, pp.digest)
+        self.old_view_preprepares[old_key] = pp
+        new_view_no = self._pending_old_view_bids.pop(old_key, None)
+        if new_view_no is None or new_view_no != self._data.view_no:
+            return  # no longer waiting (another view change happened)
+        self._apply_new_view_batch(pp, new_view_no, orig)
+        self._stasher.process_stashed(STASH_WAITING_PREV_PP)
 
     def process_checkpoint_stabilized(self, msg: CheckpointStabilized) -> None:
         """GC 3PC logs at or below the new stable checkpoint."""

@@ -83,16 +83,26 @@ def calc_batches(checkpoint: CheckpointValue,
     prepared_by_seq: Dict[int, Dict[str, int]] = {}
     preprepared_by_seq: Dict[int, Dict[str, int]] = {}
     batch_info: Dict[Tuple[int, str], list] = {}
+    def _dedup_by_vote_key(batch_ids):
+        # one vote per sender per COUNTING key (seq, digest) — deduping on
+        # the full batch-id tuple would let a byzantine VC fabricate extra
+        # votes by varying the view fields of the same (seq, digest).
+        # Order-preserving (dict) so batch_info tie-breaks are identical on
+        # every replica regardless of hash seed.
+        seen = {}
+        for b in batch_ids:
+            t = tuple(b)
+            seen.setdefault((t[2], t[3]), t)
+        return seen.values()
+
     for vc in view_changes:
-        # dedup within each VIEW_CHANGE (one vote per sender per batch id);
-        # order-preserving: replicas must agree on batch_info tie-breaks
-        for b in dict.fromkeys(map(tuple, vc.prepared)):
+        for b in _dedup_by_vote_key(vc.prepared):
             _, pp_view, seq, digest = b
             prepared_by_seq.setdefault(seq, {})
             prepared_by_seq[seq][digest] = \
                 prepared_by_seq[seq].get(digest, 0) + 1
             batch_info.setdefault((seq, digest), list(b))
-        for b in dict.fromkeys(map(tuple, vc.preprepared)):
+        for b in _dedup_by_vote_key(vc.preprepared):
             _, pp_view, seq, digest = b
             preprepared_by_seq.setdefault(seq, {})
             preprepared_by_seq[seq][digest] = \

@@ -141,7 +141,9 @@ class SimNode:
 
     def __init__(self, name: str, validators: List[str], timer: MockTimer,
                  network: SimNetwork, requests: SimRequestsPool,
-                 config: Config, device_quorum: bool = False):
+                 config: Config, device_quorum: bool = False,
+                 domain_genesis: Optional[list] = None,
+                 storage=None):
         self.name = name
         self.config = config
         self.data = ConsensusSharedData(
@@ -154,7 +156,22 @@ class SimNode:
         self.external_bus = network.create_peer(name)
         self.stasher = StashingRouter(
             limit=1000, buses=[self.internal_bus, self.external_bus])
-        self.executor = SimExecutor()
+        self.boot = None
+        if domain_genesis is not None:
+            # real execution: ledgers + SMT states + audit spine per node
+            from ..server.ledgers_bootstrap import LedgersBootstrap
+            from ..server.request_managers.write_request_manager import (
+                NodeExecutor,
+            )
+
+            self.boot = LedgersBootstrap(
+                storage=storage, domain_genesis=domain_genesis).build()
+            self.executor = NodeExecutor(
+                self.boot.write_manager,
+                get_view_info=lambda: (self.data.view_no,
+                                       list(self.data.primaries)))
+        else:
+            self.executor = SimExecutor()
         self.requests_view = requests.view_for(name)
 
         self.vote_plane = None
@@ -162,7 +179,8 @@ class SimNode:
             from ..tpu.vote_plane import DeviceVotePlane
 
             self.vote_plane = DeviceVotePlane(
-                validators, log_size=config.LOG_SIZE)
+                validators, log_size=config.LOG_SIZE,
+                n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
 
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
@@ -172,7 +190,8 @@ class SimNode:
             shadow_check=device_quorum)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
-            network=self.external_bus, stasher=self.stasher, config=config)
+            network=self.external_bus, stasher=self.stasher, config=config,
+            vote_plane=self.vote_plane, shadow_check=device_quorum)
         self.view_changer = ViewChangeService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher,
@@ -215,7 +234,9 @@ class SimNode:
 class SimPool:
     def __init__(self, n_nodes: int = 4, seed: int = 0,
                  config: Optional[Config] = None,
-                 device_quorum: bool = False):
+                 device_quorum: bool = False,
+                 real_execution: bool = False,
+                 sign_requests: bool = False):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -224,9 +245,33 @@ class SimPool:
         self.requests = SimRequestsPool()
         for name in self.validators:
             self.requests.register_node(name)
+
+        self.real_execution = real_execution
+        self.sign_requests = sign_requests
+        self.trustee = None
+        self.authnr = None
+        domain_genesis = None
+        if real_execution or sign_requests:
+            from ..common.constants import TRUSTEE
+            from ..crypto.signers import DidSigner
+            from ..ledger.genesis import genesis_nym_txn
+
+            self.trustee = DidSigner(b"\x09" * 32)
+            domain_genesis = [genesis_nym_txn(
+                self.trustee.identifier, self.trustee.verkey, role=TRUSTEE)]
+        if sign_requests:
+            from ..server.client_authn import CoreAuthNr
+
+            # the ingress gate: genesis identities via seed_keys (node-state
+            # backed resolution arrives with the Node composition)
+            self.authnr = CoreAuthNr(seed_keys={
+                self.trustee.identifier: self.trustee.verkey})
+        self._ingress: List[Request] = []
+
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
-                    self.requests, self.config, device_quorum=device_quorum)
+                    self.requests, self.config, device_quorum=device_quorum,
+                    domain_genesis=domain_genesis if real_execution else None)
             for name in self.validators]
         self.network.connect_all()
 
@@ -238,10 +283,46 @@ class SimPool:
         return self.node(self.nodes[0].data.primaries[0])
 
     def submit_request(self, seq: int) -> Request:
-        req = Request(identifier="client1", reqId=seq,
-                      operation={"type": "1", "v": seq})
-        self.requests.add_finalised(req)
+        if self.real_execution:
+            from ..common.constants import NYM, TARGET_NYM, TXN_TYPE, VERKEY
+            from ..crypto.signers import DidSigner
+
+            target = DidSigner(hashlib.sha256(
+                b"sim-target-%d" % seq).digest())
+            req = Request(
+                identifier=self.trustee.identifier, reqId=seq,
+                operation={TXN_TYPE: NYM, TARGET_NYM: target.identifier,
+                           VERKEY: target.verkey})
+            req.target_signer = target  # test convenience
+        else:
+            req = Request(identifier="client1", reqId=seq,
+                          operation={"type": "1", "v": seq})
+        if self.sign_requests:
+            self.trustee.sign_request(req)
+            self._ingress.append(req)
+        else:
+            self.requests.add_finalised(req)
         return req
+
+    def submit_tampered_request(self, seq: int) -> Request:
+        """Signed, then payload mutated: the device verify must reject it."""
+        assert self.sign_requests
+        req = self.submit_request(seq)
+        req.operation["evil"] = True  # signature no longer covers payload
+        return req
+
+    def flush_ingress(self):
+        """The node-ingress pipeline stand-in: device-batch-verify pending
+        signed requests; only verified ones become finalised. Returns the
+        verdict vector (test observability)."""
+        if not self._ingress:
+            return []
+        batch, self._ingress = self._ingress, []
+        verdicts = self.authnr.authenticate_batch(batch)
+        for req, ok in zip(batch, verdicts):
+            if ok:
+                self.requests.add_finalised(req)
+        return list(verdicts)
 
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)

@@ -168,9 +168,17 @@ class SparseMerkleState(State):
         return self._lookup(root, key)
 
     def commit(self, root_hash: Optional[bytes] = None) -> None:
+        """Advance the committed head.
+
+        With ``root_hash`` given, only the committed pointer moves — the
+        working head stays at the tip, so later staged (pipelined) batches
+        survive committing an earlier one. Without it, everything staged
+        becomes committed (head == tip).
+        """
         self._committed_root = root_hash if root_hash is not None \
             else self._root
-        self._root = self._committed_root
+        if root_hash is None:
+            self._root = self._committed_root
         if self._dirty:
             self._kv.do_batch(list(self._dirty.items()))
             self._dirty.clear()
@@ -178,6 +186,11 @@ class SparseMerkleState(State):
 
     def revert_to_head(self) -> None:
         self._root = self._committed_root
+
+    def set_head_hash(self, root: bytes) -> None:
+        """Move the working head to a known root (LIFO batch revert: nodes
+        are content-addressed, so any recorded root remains reachable)."""
+        self._root = root
 
     @property
     def head_hash(self) -> bytes:

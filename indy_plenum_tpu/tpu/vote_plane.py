@@ -16,6 +16,13 @@ stabilization and ``reset`` clears it on view change.
 
 Per the vote-inclusion contract in :mod:`indy_plenum_tpu.tpu.quorum`, the
 caller records its OWN votes too, not just received messages.
+
+Flush granularity: a quorum query flushes whatever is pending, so in the
+per-message sim loop each message typically costs one padded device step —
+correct but not amortized. Amortization comes from the callers that batch:
+the ingress path verifies whole request batches, and the dense-pool bench
+packs entire protocol rounds per step. A future Node event loop should
+drain deliveries before querying (one flush per tick).
 """
 from __future__ import annotations
 
@@ -79,6 +86,7 @@ class DeviceVotePlane:
         self._host_prepared: Optional[np.ndarray] = None
         self._host_prepare_counts: Optional[np.ndarray] = None
         self._host_commit_counts: Optional[np.ndarray] = None
+        self._host_stable: Optional[np.ndarray] = None
         self.flushes = 0
 
     # --- recording ------------------------------------------------------
@@ -117,6 +125,33 @@ class DeviceVotePlane:
         if 0 <= chk_slot < self._n_chk and sender in self._index:
             self._pending.append((q.CHECKPOINT, self._index[sender], chk_slot))
             self._events = None
+
+    def checkpoint_slot(self, seq_no_end: int, chk_freq: int) -> Optional[int]:
+        """Checkpoint boundary seqNoEnd -> window-relative checkpoint slot.
+
+        Boundaries sit at multiples of CHK_FREQ above the stable watermark
+        h (itself a stabilized boundary), so slot = (end - h)/freq - 1.
+        """
+        delta = seq_no_end - self._h
+        if delta <= 0 or delta % chk_freq != 0:
+            return None
+        slot = delta // chk_freq - 1
+        return slot if slot < self._n_chk else None
+
+    def record_checkpoint_vote(self, sender: str, seq_no_end: int,
+                               chk_freq: int) -> None:
+        slot = self.checkpoint_slot(seq_no_end, chk_freq)
+        if slot is not None:
+            self.record_checkpoint(sender, slot)
+
+    def has_checkpoint_quorum(self, seq_no_end: int, chk_freq: int) -> bool:
+        """n-f checkpoint votes at the boundary (OWN vote included — see
+        the vote-inclusion contract in tpu.quorum)."""
+        slot = self.checkpoint_slot(seq_no_end, chk_freq)
+        if slot is None:
+            return False
+        self.events()
+        return bool(self._host_stable[slot])
 
     # --- window management ---------------------------------------------
 
@@ -157,6 +192,7 @@ class DeviceVotePlane:
             self._host_prepare_counts = np.asarray(
                 self._events.prepare_counts)
             self._host_commit_counts = np.asarray(self._events.commit_counts)
+            self._host_stable = np.asarray(self._events.stable_checkpoints)
         return self._events
 
     def has_prepare_quorum(self, pp_seq_no: int) -> bool:

@@ -1,0 +1,143 @@
+"""CoreAuthNr: verkey resolution from NYM state + device batch verify.
+
+Covers the BASELINE.json north-star symbol (`CoreAuthNr.authenticate`):
+signed request -> verkey from SparseMerkleState (NymHandler layout) ->
+Ed25519 verify, single (host oracle) and batched (device kernel).
+"""
+import numpy as np
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    DOMAIN_LEDGER_ID,
+    NYM,
+    TARGET_NYM,
+    TXN_TYPE,
+    VERKEY,
+)
+from indy_plenum_tpu.common.exceptions import (
+    CouldNotAuthenticate,
+    InvalidSignature,
+    MissingSignature,
+)
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.common.txn_util import append_txn_metadata, reqToTxn
+from indy_plenum_tpu.crypto.signers import (
+    DidSigner,
+    SimpleSigner,
+    resolve_verkey_bytes,
+)
+from indy_plenum_tpu.server.client_authn import CoreAuthNr, ReqAuthenticator
+from indy_plenum_tpu.server.database_manager import DatabaseManager
+from indy_plenum_tpu.server.request_handlers.nym_handler import NymHandler
+from indy_plenum_tpu.state.sparse_merkle_state import SparseMerkleState
+
+SEEDS = [bytes([i]) * 32 for i in range(1, 9)]
+
+
+def make_domain():
+    db = DatabaseManager()
+    db.register_new_database(DOMAIN_LEDGER_ID, None, SparseMerkleState())
+    return db, NymHandler(db)
+
+
+def write_nym(handler, signer, seq):
+    req = Request(identifier=signer.identifier, reqId=seq,
+                  operation={TXN_TYPE: NYM, TARGET_NYM: signer.identifier,
+                             VERKEY: signer.verkey})
+    txn = append_txn_metadata(reqToTxn(req), seq_no=seq,
+                              txn_time=1_700_000_000 + seq)
+    handler.update_state(txn, None)
+    handler.state.commit()
+
+
+def signed_request(signer, seq, payload=None):
+    req = Request(reqId=seq,
+                  operation=payload or {TXN_TYPE: NYM, TARGET_NYM: "X", "v": seq})
+    signer.sign_request(req)
+    return req
+
+
+def test_did_signer_verkey_roundtrip():
+    s = DidSigner(SEEDS[0])
+    assert s.verkey.startswith("~")
+    assert resolve_verkey_bytes(s.identifier, s.verkey) == s.verkey_raw
+    simple = SimpleSigner(SEEDS[1])
+    assert resolve_verkey_bytes(simple.identifier, None) == simple.verkey_raw
+    assert resolve_verkey_bytes(simple.identifier, simple.verkey) \
+        == simple.verkey_raw
+
+
+def test_authenticate_from_state():
+    db, handler = make_domain()
+    signer = DidSigner(SEEDS[0])
+    write_nym(handler, signer, 1)
+    authnr = CoreAuthNr(verkey_source=handler)
+    req = signed_request(signer, 7)
+    assert authnr.authenticate(req) == [signer.identifier]
+    # tampered payload -> InvalidSignature
+    req.operation["v"] = 999
+    with pytest.raises(InvalidSignature):
+        authnr.authenticate(req)
+
+
+def test_authenticate_unknown_and_missing():
+    authnr = CoreAuthNr()
+    req = signed_request(DidSigner(SEEDS[2]), 1)
+    # DID (16 bytes) is not a cryptonym and no state/seed entry exists
+    with pytest.raises(CouldNotAuthenticate):
+        authnr.authenticate(req)
+    unsigned = Request(identifier="abc", reqId=2, operation={"k": 1})
+    with pytest.raises(MissingSignature):
+        authnr.authenticate(unsigned)
+
+
+def test_cryptonym_simple_signer_needs_no_state():
+    signer = SimpleSigner(SEEDS[3])
+    authnr = CoreAuthNr()
+    req = signed_request(signer, 3)
+    assert authnr.authenticate(req) == [signer.identifier]
+
+
+def test_seed_keys_bootstrap():
+    signer = DidSigner(SEEDS[4])
+    authnr = CoreAuthNr(seed_keys={signer.identifier: signer.verkey})
+    req = signed_request(signer, 4)
+    assert authnr.authenticate(req) == [signer.identifier]
+
+
+def test_authenticate_batch_device_matches_host():
+    db, handler = make_domain()
+    signers = [DidSigner(s) for s in SEEDS[:4]]
+    for i, s in enumerate(signers):
+        write_nym(handler, s, i + 1)
+    authnr = CoreAuthNr(verkey_source=handler)
+
+    reqs = [signed_request(signers[i % 4], 100 + i) for i in range(10)]
+    # corrupt: tamper payload of #3, break signature encoding of #5,
+    # unknown signer for #7
+    reqs[3].operation["v"] = -1
+    reqs[5].signature = "!!!not-base58!!!"
+    reqs[7] = signed_request(DidSigner(SEEDS[7]), 999)
+
+    verdict = authnr.authenticate_batch(reqs)
+    expected = []
+    for r in reqs:
+        try:
+            authnr.authenticate(r)
+            expected.append(True)
+        except Exception:
+            expected.append(False)
+    assert verdict.tolist() == expected
+    assert verdict.sum() == 7
+    assert not verdict[3] and not verdict[5] and not verdict[7]
+
+
+def test_req_authenticator_registry():
+    signer = SimpleSigner(SEEDS[5])
+    ra = ReqAuthenticator()
+    req = signed_request(signer, 1)
+    with pytest.raises(CouldNotAuthenticate):
+        ra.authenticate(req)
+    ra.register_authenticator(CoreAuthNr())
+    assert ra.authenticate(req) == [signer.identifier]
+    assert ra.core_authenticator is not None

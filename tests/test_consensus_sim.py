@@ -128,6 +128,35 @@ def test_view_change_preserves_prepared_batches():
     assert len(logs[0]) == 3, logs[0]
 
 
+def test_new_primary_fetches_old_view_preprepare_it_never_saw():
+    """NEW_VIEW can select a batch the new primary never received: it must
+    fetch the old-view PRE-PREPARE from the pool (any prepared node has it)
+    and re-order it — otherwise ordering stalls at that seqNo forever."""
+    pool = SimPool(4, seed=41)
+    # node1 (the next primary) never sees the PRE-PREPARE; commits are held
+    # back so nobody orders in view 0
+    pool.network.add_delayer(delay_message_types(PrePrepare, to="node1"))
+    undelay_commits = pool.network.add_delayer(delay_message_types(Commit))
+    pool.submit_request(0)
+    pool.run_for(3)
+    prepared = [n.name for n in pool.nodes if n.data.prepared]
+    assert "node1" not in prepared and len(prepared) >= 2
+    assert all(len(n.ordered_digests) == 0 for n in pool.nodes)
+
+    pool.network.disconnect("node0")
+    undelay_commits()
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+
+    survivors = [n for n in pool.nodes if n.name != "node0"]
+    for node in survivors:
+        assert node.data.view_no >= 1
+        assert not node.data.waiting_for_new_view
+        assert len(node.ordered_digests) == 1, (
+            node.name, node.ordered_digests)
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+
+
 def test_delayers_slow_node_still_catches_up_in_window():
     pool = SimPool(4, seed=6)
     # node3 receives PREPAREs 1s late — still orders, just behind

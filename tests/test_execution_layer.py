@@ -1,0 +1,165 @@
+"""Execution layer: WriteRequestManager + audit ledger + bootstrap.
+
+Covers the Executor seam contract (speculative apply -> roots, LIFO revert,
+historical roots at/below committed height), the audit ledger as recovery
+spine, genesis bootstrap, restart recovery and state rebuild from ledger.
+"""
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID,
+    DOMAIN_LEDGER_ID,
+    NYM,
+    ROLE,
+    STEWARD,
+    TARGET_NYM,
+    TRUSTEE,
+    TXN_TYPE,
+    VERKEY,
+)
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.crypto.signers import DidSigner
+from indy_plenum_tpu.ledger.genesis import genesis_nym_txn
+from indy_plenum_tpu.server.ledgers_bootstrap import (
+    LedgersBootstrap,
+    NodeStorage,
+)
+from indy_plenum_tpu.server.request_managers.write_request_manager import (
+    NodeExecutor,
+)
+
+TRUSTEE_SIGNER = DidSigner(b"\x01" * 32)
+T0 = 1_700_000_000
+
+
+def make_bootstrap(storage=None):
+    boot = LedgersBootstrap(
+        storage=storage,
+        domain_genesis=[genesis_nym_txn(
+            TRUSTEE_SIGNER.identifier, TRUSTEE_SIGNER.verkey, role=TRUSTEE)],
+    )
+    return boot.build()
+
+
+def nym_request(seq, target=None, role=None):
+    signer = target or DidSigner(bytes([seq % 250 + 1]) * 32)
+    op = {TXN_TYPE: NYM, TARGET_NYM: signer.identifier, VERKEY: signer.verkey}
+    if role is not None:
+        op[ROLE] = role
+    return Request(identifier=TRUSTEE_SIGNER.identifier, reqId=seq,
+                   operation=op), signer
+
+
+def test_apply_commit_nym_readable():
+    boot = make_bootstrap()
+    ex = NodeExecutor(boot.write_manager)
+    req, signer = nym_request(1)
+    state_root, txn_root = ex.apply_batch([req], DOMAIN_LEDGER_ID, T0, 1)
+    assert state_root and txn_root
+    # uncommitted: visible at head, not at committed root
+    assert boot.nym_handler.get_nym_data(signer.identifier,
+                                         is_committed=False) is not None
+    assert boot.nym_handler.get_nym_data(signer.identifier,
+                                         is_committed=True) is None
+    ex.commit_batch(1)
+    data = boot.nym_handler.get_nym_data(signer.identifier, is_committed=True)
+    assert data is not None and data[VERKEY] == signer.verkey
+    assert ex.committed_seq() == 1
+    assert boot.db.get_ledger(AUDIT_LEDGER_ID).size == 1
+
+
+def test_lifo_revert_restores_roots():
+    boot = make_bootstrap()
+    ex = NodeExecutor(boot.write_manager)
+    domain = boot.db.get_state(DOMAIN_LEDGER_ID)
+    ledger = boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    root0, lsize0 = domain.head_hash, ledger.uncommitted_size
+
+    r1, s1 = nym_request(1)
+    r2, s2 = nym_request(2)
+    ex.apply_batch([r1], DOMAIN_LEDGER_ID, T0, 1)
+    root1 = domain.head_hash
+    ex.apply_batch([r2], DOMAIN_LEDGER_ID, T0 + 1, 2)
+    assert domain.head_hash != root1
+
+    ex.revert_batches(DOMAIN_LEDGER_ID, 1)  # newest first
+    assert domain.head_hash == root1
+    assert boot.db.get_ledger(AUDIT_LEDGER_ID).uncommitted_size == 1
+    ex.revert_batches(DOMAIN_LEDGER_ID, 1)
+    assert domain.head_hash == root0
+    assert ledger.uncommitted_size == lsize0
+    assert boot.db.get_ledger(AUDIT_LEDGER_ID).uncommitted_size == 0
+
+
+def test_historical_roots_below_committed():
+    boot = make_bootstrap()
+    ex = NodeExecutor(boot.write_manager)
+    req, _ = nym_request(1)
+    roots = ex.apply_batch([req], DOMAIN_LEDGER_ID, T0, 1)
+    ex.commit_batch(1)
+    ledger_size = boot.db.get_ledger(DOMAIN_LEDGER_ID).size
+    # re-apply at committed height: historical roots, NO re-execution
+    again = ex.apply_batch([req], DOMAIN_LEDGER_ID, T0, 1)
+    assert again == roots
+    assert boot.db.get_ledger(DOMAIN_LEDGER_ID).size == ledger_size
+    assert not boot.write_manager.staged_batches
+
+
+def test_dynamic_validation_enforced():
+    from indy_plenum_tpu.common.exceptions import UnauthorizedClientRequest
+
+    boot = make_bootstrap()
+    ex = NodeExecutor(boot.write_manager)
+    nobody = DidSigner(b"\x77" * 32)
+    evil = Request(identifier=nobody.identifier, reqId=1,
+                   operation={TXN_TYPE: NYM, TARGET_NYM: nobody.identifier,
+                              VERKEY: nobody.verkey})
+    with pytest.raises(UnauthorizedClientRequest):
+        ex.apply_batch([evil], DOMAIN_LEDGER_ID, T0, 1)
+
+
+def test_restart_resumes_at_committed_height():
+    storage = NodeStorage()
+    boot = make_bootstrap(storage)
+    ex = NodeExecutor(boot.write_manager)
+    signers = []
+    for seq in (1, 2, 3):
+        req, s = nym_request(seq)
+        signers.append(s)
+        ex.apply_batch([req], DOMAIN_LEDGER_ID, T0 + seq, seq)
+        ex.commit_batch(seq)
+    domain_root = boot.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash
+
+    # "restart": a fresh bootstrap over the same durable stores
+    boot2 = make_bootstrap(storage)
+    assert boot2.committed_pp_seq_no == 3
+    assert boot2.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash \
+        == domain_root
+    for s in signers:
+        assert boot2.nym_handler.get_nym_data(
+            s.identifier, is_committed=True) is not None
+    # and it can keep executing from there
+    ex2 = NodeExecutor(boot2.write_manager)
+    req, s4 = nym_request(4)
+    ex2.apply_batch([req], DOMAIN_LEDGER_ID, T0 + 9, 4)
+    ex2.commit_batch(4)
+    assert ex2.committed_seq() == 4
+
+
+def test_state_rebuild_from_ledger():
+    storage = NodeStorage()
+    boot = make_bootstrap(storage)
+    ex = NodeExecutor(boot.write_manager)
+    for seq in (1, 2):
+        req, _ = nym_request(seq)
+        ex.apply_batch([req], DOMAIN_LEDGER_ID, T0 + seq, seq)
+        ex.commit_batch(seq)
+    good_root = boot.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash
+
+    # simulate losing the domain state store (ledger + audit survive)
+    from indy_plenum_tpu.storage.kv_store import KeyValueStorageInMemory
+
+    storage.state_stores[DOMAIN_LEDGER_ID] = KeyValueStorageInMemory()
+    boot2 = make_bootstrap(storage)
+    assert boot2.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash \
+        == good_root

@@ -1,0 +1,74 @@
+"""The north-star e2e: signed request -> device verify -> 3PC -> commit.
+
+VERDICT round-1 item 3: put signatures in the protocol path. A trustee
+client signs NYM requests; the ingress gate batch-verifies them on the
+device (CoreAuthNr.authenticate_batch); verified requests order through
+real 3PC with the device quorum plane; commit executes them against real
+ledgers + sparse-Merkle state; the created NYM is then readable from every
+node's committed state. A tampered request is rejected at the gate and
+never orders.
+"""
+from indy_plenum_tpu.common.constants import VERKEY
+from indy_plenum_tpu.simulation.pool import SimPool
+
+
+def test_signed_nym_e2e_with_device_verify_and_quorum():
+    pool = SimPool(4, seed=31, real_execution=True, sign_requests=True,
+                   device_quorum=True)
+    reqs = [pool.submit_request(i) for i in range(5)]
+    tampered = pool.submit_tampered_request(99)
+
+    verdicts = pool.flush_ingress()
+    assert verdicts == [True] * 5 + [False]
+
+    pool.run_for(10)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert len(node.ordered_digests) == 5, node.name
+        assert tampered.digest not in node.ordered_digests
+        # committed, durable, readable: every created NYM resolves
+        for req in reqs:
+            target = req.target_signer
+            data = node.boot.nym_handler.get_nym_data(
+                target.identifier, is_committed=True)
+            assert data is not None, (node.name, req.reqId)
+            assert data[VERKEY] == target.verkey
+        # the audit spine recorded every batch
+        assert node.executor.committed_seq() \
+            == node.data.last_ordered_3pc[1]
+
+
+def test_real_execution_view_change_reverts_and_reorders():
+    pool = SimPool(4, seed=32, real_execution=True)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(5)
+    assert all(len(n.ordered_digests) == 4 for n in pool.nodes)
+
+    primary_name = pool.nodes[0].data.primaries[0]
+    pool.network.disconnect(primary_name)
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 8)
+
+    for i in range(100, 104):
+        pool.submit_request(i)
+    pool.run_for(10)
+    survivors = [n for n in pool.nodes if n.name != primary_name]
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+    assert len(logs[0]) == 8
+    roots = {n.boot.db.get_state(1).committed_head_hash for n in survivors}
+    assert len(roots) == 1, "state divergence after view change"
+
+
+def test_real_execution_all_roots_agree():
+    pool = SimPool(4, seed=33, real_execution=True)
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert all(len(n.ordered_digests) == 12 for n in pool.nodes)
+    for lid in (0, 1, 2, 3):
+        roots = {bytes(n.boot.db.get_ledger(lid).root_hash)
+                 for n in pool.nodes}
+        assert len(roots) == 1, f"ledger {lid} diverged"
+    states = {n.boot.db.get_state(1).committed_head_hash for n in pool.nodes}
+    assert len(states) == 1

@@ -89,6 +89,11 @@ def test_duplicate_entries_in_one_vc_do_not_fabricate_quorum():
     b = (1, 0, 5, "d")
     vcs = [vc(prepared=[b], preprepared=[b, b]), vc(), vc()]
     assert calc_batches((0, 0, "stable"), vcs, Q4) == []
+    # varying the view fields of the same (seq, digest) must not create
+    # extra votes either (dedup is on the counting key, not the tuple)
+    b2 = (1, 1, 5, "d")
+    vcs = [vc(prepared=[b], preprepared=[b, b2]), vc(), vc()]
+    assert calc_batches((0, 0, "stable"), vcs, Q4) == []
 
 
 def test_view_change_digest_stable():

@@ -1,0 +1,182 @@
+"""The protocol side of BLS: sign state roots in COMMIT, aggregate at order.
+
+Reference: plenum/bls/bls_bft_replica_plenum.py (`BlsBftReplicaPlenum`),
+implementing the seam declared by
+:class:`indy_plenum_tpu.server.consensus.ordering_service.NoOpBlsBftReplica`:
+
+- ``update_pre_prepare``: attach the latest known multi-sig to outgoing
+  PRE-PREPAREs (propagates proofs of *previous* roots through the pool);
+- ``validate_pre_prepare``: verify an attached multi-sig (suspicion
+  PPR_BLS_MULTISIG_WRONG on failure);
+- ``update_commit``: BLS-sign the batch's MultiSignatureValue;
+- ``validate_commit``: OPTIMISTIC — individual COMMIT signatures are
+  recorded without a pairing check; the aggregate is verified once at
+  ordering time and only on failure are individual signatures re-checked
+  to identify the culprit (aggregate-first is the batch-friendly, TPU-first
+  discipline: one pairing check per ordered batch instead of n);
+- ``process_order``: aggregate n-f valid signatures into a MultiSignature,
+  persist it to the BlsStore keyed by state root (state-proof reads), and
+  remember it for the next PRE-PREPARE.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from ..common.exceptions import SuspiciousNode
+from ..crypto.bls.bls_crypto import (
+    BlsCryptoSigner,
+    BlsCryptoVerifier,
+    MultiSignature,
+    MultiSignatureValue,
+)
+from ..server.suspicion_codes import Suspicions
+from .bls_key_register import BlsKeyRegister
+from .bls_store import BlsStore
+
+logger = logging.getLogger(__name__)
+
+
+class BlsBftReplica:
+    def __init__(self,
+                 node_name: str,
+                 signer: BlsCryptoSigner,
+                 key_register: BlsKeyRegister,
+                 store: Optional[BlsStore] = None,
+                 pool_state_root_provider=None):
+        self._name = node_name
+        self._signer = signer
+        self._verifier = BlsCryptoVerifier()
+        self._register = key_register
+        self._store = store if store is not None else BlsStore()
+        self._pool_root = pool_state_root_provider or (lambda: "")
+        # (view_no, pp_seq_no) -> sender -> sig b58
+        self._sigs: Dict[Tuple[int, int], Dict[str, str]] = {}
+        self._latest_multi_sig: Optional[MultiSignature] = None
+
+    # --- value under signature -----------------------------------------
+
+    def _value_for(self, pp) -> Optional[MultiSignatureValue]:
+        if pp is None or pp.stateRootHash is None:
+            return None
+        return MultiSignatureValue(
+            ledger_id=pp.ledgerId,
+            state_root_hash=pp.stateRootHash,
+            pool_state_root_hash=pp.poolStateRootHash or self._pool_root(),
+            txn_root_hash=pp.txnRootHash or "",
+            timestamp=pp.ppTime,
+        )
+
+    # --- PRE-PREPARE ----------------------------------------------------
+
+    def update_pre_prepare(self, params: dict, ledger_id) -> dict:
+        if self._latest_multi_sig is not None:
+            params["blsMultiSig"] = self._latest_multi_sig.as_dict()
+        return params
+
+    def validate_pre_prepare(self, pp, sender) -> None:
+        raw = getattr(pp, "blsMultiSig", None)
+        if raw is None:
+            return
+        try:
+            ms = MultiSignature.from_dict(dict(raw))
+        except (KeyError, TypeError, ValueError):
+            raise SuspiciousNode(
+                sender, Suspicions.PPR_BLS_MULTISIG_WRONG) from None
+        pks = self._register.get_keys(ms.participants)
+        if pks is None or not self._verifier.verify_multi_sig(
+                ms.signature, ms.value.serialize(), pks):
+            raise SuspiciousNode(sender, Suspicions.PPR_BLS_MULTISIG_WRONG)
+
+    def process_pre_prepare(self, pp, sender) -> None:
+        raw = getattr(pp, "blsMultiSig", None)
+        if raw is None:
+            return
+        ms = MultiSignature.from_dict(dict(raw))  # validated above
+        self._store.put(ms)
+        self._latest_multi_sig = ms
+
+    # --- PREPARE (nothing to do) ----------------------------------------
+
+    def process_prepare(self, prepare, sender) -> None:
+        pass
+
+    # --- COMMIT ---------------------------------------------------------
+
+    def update_commit(self, params: dict, pp) -> dict:
+        value = self._value_for(pp)
+        if value is not None:
+            params["blsSig"] = self._signer.sign(value.serialize())
+        return params
+
+    def validate_commit(self, commit, sender, pp) -> None:
+        # optimistic: defer pairing checks to aggregation (see module doc).
+        # Structural sanity only — a missing signature is fine (not every
+        # node must have BLS keys), garbage strings are dropped here.
+        sig = getattr(commit, "blsSig", None)
+        if sig is not None and not isinstance(sig, str):
+            raise SuspiciousNode(sender, Suspicions.CM_BLS_WRONG)
+
+    def process_commit(self, commit, sender) -> None:
+        sig = getattr(commit, "blsSig", None)
+        if sig is None:
+            return
+        key = (commit.viewNo, commit.ppSeqNo)
+        self._sigs.setdefault(key, {})[sender] = sig
+
+    # --- ordering -------------------------------------------------------
+
+    def process_order(self, key, quorums, pp) -> None:
+        value = self._value_for(pp)
+        if value is None:
+            return
+        sigs = dict(self._sigs.get(key, {}))
+        # include our own signature (we signed in update_commit only if we
+        # sent a COMMIT; recompute — signing is cheap, one G1 mul)
+        sigs[self._name] = self._signer.sign(value.serialize())
+        if not quorums.bls_signatures.is_reached(len(sigs)):
+            logger.debug("%s: no BLS quorum for %s (%d sigs)", self._name,
+                         key, len(sigs))
+            return
+        participants = sorted(sigs)
+        message = value.serialize()
+        agg = self._verifier.aggregate_sigs([sigs[p] for p in participants])
+        pks = self._register.get_keys(participants)
+        if pks is None:
+            return
+        if not self._verifier.verify_multi_sig(agg, message, pks):
+            # optimistic path failed: find the culprit(s) individually
+            good = []
+            for p in participants:
+                pk = self._register.get_key(p)
+                if pk and self._verifier.verify_sig(sigs[p], message, pk):
+                    good.append(p)
+                else:
+                    logger.warning("%s: invalid BLS sig from %s at %s",
+                                   self._name, p, key)
+            if not quorums.bls_signatures.is_reached(len(good)):
+                return
+            participants = good
+            agg = self._verifier.aggregate_sigs(
+                [sigs[p] for p in participants])
+        ms = MultiSignature(signature=agg, participants=participants,
+                            value=value)
+        self._store.put(ms)
+        self._latest_multi_sig = ms
+
+    # --- GC -------------------------------------------------------------
+
+    def gc(self, key_3pc) -> None:
+        stable_seq = key_3pc[1]
+        self._sigs = {k: v for k, v in self._sigs.items()
+                      if k[1] > stable_seq}
+
+    # --- reads (state proofs) -------------------------------------------
+
+    @property
+    def store(self) -> BlsStore:
+        return self._store
+
+    @property
+    def latest_multi_sig(self) -> Optional[MultiSignature]:
+        return self._latest_multi_sig

@@ -1,0 +1,69 @@
+"""Client-side state-proof verification: trust ONE node's answer.
+
+Reference: the client half of SURVEY.md §3.5 — a read reply carries
+{value, state proof, BLS multi-signature}; the client checks (a) the
+sparse-Merkle inclusion proof against the claimed root and (b) the pool's
+n-f multi-signature over that root, so a single node's reply is as
+trustworthy as f+1 matching replies.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ..crypto.bls.bls_crypto import BlsCryptoVerifier, MultiSignature
+from ..state.sparse_merkle_state import verify_state_proof
+from ..utils.base58 import b58decode, b58encode
+
+
+class StateProofReply:
+    """What a node returns for a proved read."""
+
+    def __init__(self, key: bytes, value: Optional[bytes],
+                 root: bytes, proof: bytes,
+                 multi_sig_dict: Optional[dict]):
+        self.key = key
+        self.value = value
+        self.root = root
+        self.proof = proof
+        self.multi_sig = (MultiSignature.from_dict(multi_sig_dict)
+                          if multi_sig_dict else None)
+
+    def as_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "value": self.value,
+            "root": b58encode(self.root),
+            "proof": self.proof,
+            "multi_sig": self.multi_sig.as_dict() if self.multi_sig else None,
+        }
+
+
+def verify_proved_reply(reply: StateProofReply,
+                        pool_bls_keys: Dict[str, str],
+                        min_participants: int) -> bool:
+    """True iff the reply proves (key -> value) under a root co-signed by
+    >= min_participants validators (n-f for the reading client).
+
+    ``pool_bls_keys``: node name -> BLS pk b58 (from the pool ledger /
+    genesis — the client's trust anchor).
+    """
+    # 1. the Merkle proof binds (key, value) to the root
+    if not verify_state_proof(reply.root, reply.key, reply.value,
+                              reply.proof):
+        return False
+    # 2. the multi-sig binds the root to the pool
+    ms = reply.multi_sig
+    if ms is None:
+        return False
+    if ms.value.state_root_hash != b58encode(reply.root):
+        return False
+    if len(set(ms.participants)) < min_participants:
+        return False
+    pks = []
+    for name in ms.participants:
+        pk = pool_bls_keys.get(name)
+        if pk is None:
+            return False  # signed by someone outside the pool
+        pks.append(pk)
+    return BlsCryptoVerifier.verify_multi_sig(
+        ms.signature, ms.value.serialize(), pks)

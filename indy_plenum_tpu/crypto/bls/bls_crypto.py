@@ -1,0 +1,252 @@
+"""BLS signatures over BN254: sign / verify / aggregate + value objects.
+
+Reference: crypto/bls/bls_crypto.py (`BlsCryptoSigner`, `BlsCryptoVerifier`)
+and crypto/bls/bls_multi_signature.py (`MultiSignature`,
+`MultiSignatureValue`); concrete backend analog of
+crypto/bls/indy_crypto/bls_crypto_indy_crypto.py (ursa/AMCL BN254 in Rust —
+Rust is unavailable here, so the host backend is the pure-Python
+:mod:`indy_plenum_tpu.crypto.bls.bn254` pairing library).
+
+Scheme: signatures in G1, public keys in G2 (small sigs, one G2 key per
+validator), hash-to-G1 by try-and-increment over sha256 (constant-time is
+NOT required: inputs are public protocol data). Proof of possession = BLS
+signature over the serialized public key (rogue-key defence).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence
+
+from ...utils.base58 import b58decode, b58encode
+from . import bn254 as bn
+
+# --- point serialization (wire: base58 of fixed-width big-endian) ---------
+
+
+def g1_to_bytes(pt: bn.G1Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def g1_from_bytes(data: bytes) -> bn.G1Point:
+    if len(data) != 64:
+        raise ValueError("G1 point must be 64 bytes")
+    if data == b"\x00" * 64:
+        return None
+    pt = (int.from_bytes(data[:32], "big"), int.from_bytes(data[32:], "big"))
+    if not bn.g1_is_on_curve(pt):
+        raise ValueError("point not on G1")
+    return pt
+
+
+def g2_to_bytes(pt: bn.G2Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    (x0, x1), (y0, y1) = pt
+    return b"".join(v.to_bytes(32, "big") for v in (x0, x1, y0, y1))
+
+
+def g2_from_bytes(data: bytes) -> bn.G2Point:
+    if len(data) != 128:
+        raise ValueError("G2 point must be 128 bytes")
+    if data == b"\x00" * 128:
+        return None
+    vals = [int.from_bytes(data[i:i + 32], "big") for i in range(0, 128, 32)]
+    pt = ((vals[0], vals[1]), (vals[2], vals[3]))
+    if not bn.g2_is_on_curve(pt):
+        raise ValueError("point not on E'")
+    return pt
+
+
+# --- hash to G1 (try-and-increment) ---------------------------------------
+
+
+def hash_to_g1(msg: bytes) -> bn.G1Point:
+    ctr = 0
+    while True:
+        h = hashlib.sha256(msg + ctr.to_bytes(4, "big")).digest()
+        x = int.from_bytes(h, "big") % bn.P
+        rhs = (x * x * x + 3) % bn.P
+        y = pow(rhs, (bn.P + 1) // 4, bn.P)
+        if y * y % bn.P == rhs:
+            # normalize sign deterministically
+            if y > bn.P // 2:
+                y = bn.P - y
+            return (x, y)
+        ctr += 1
+
+
+# --- key generation / sign / verify / aggregate ----------------------------
+
+
+class BlsKeyPair:
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        self.sk = int.from_bytes(
+            hashlib.sha512(b"bls-bn254-sk" + seed).digest(), "big") % bn.R
+        self.pk: bn.G2Point = bn.g2_mul(bn.G2_GEN, self.sk)
+
+    @property
+    def pk_b58(self) -> str:
+        return b58encode(g2_to_bytes(self.pk))
+
+    def pop(self) -> str:
+        """Proof of possession: BLS sig over the serialized pubkey."""
+        return b58encode(g1_to_bytes(
+            bn.g1_mul(hash_to_g1(g2_to_bytes(self.pk)), self.sk)))
+
+
+class BlsCryptoSigner:
+    """Reference: BlsCryptoSigner (indy-crypto backend)."""
+
+    def __init__(self, keypair: BlsKeyPair):
+        self._kp = keypair
+
+    @property
+    def pk(self) -> str:
+        return self._kp.pk_b58
+
+    def sign(self, message: bytes) -> str:
+        sig = bn.g1_mul(hash_to_g1(message), self._kp.sk)
+        return b58encode(g1_to_bytes(sig))
+
+
+# validator keys are static between NODE txns: memoize the expensive
+# subgroup membership checks (r*Q == O is a full scalar mul)
+_SUBGROUP_CACHE: Dict[str, bool] = {}
+
+
+def _g2_checked(pk_b58: str) -> Optional[bn.G2Point]:
+    """Decode a G2 key with a cached subgroup check; None if invalid."""
+    ok = _SUBGROUP_CACHE.get(pk_b58)
+    try:
+        pk = g2_from_bytes(b58decode(pk_b58))
+    except ValueError:
+        return None
+    if pk is None:
+        return None
+    if ok is None:
+        ok = bn.g2_in_subgroup(pk)
+        if len(_SUBGROUP_CACHE) > 4096:
+            _SUBGROUP_CACHE.clear()
+        _SUBGROUP_CACHE[pk_b58] = ok
+    return pk if ok else None
+
+
+class BlsCryptoVerifier:
+    """Reference: BlsCryptoVerifier. Stateless pairing checks."""
+
+    @staticmethod
+    def verify_sig(signature_b58: str, message: bytes, pk_b58: str) -> bool:
+        try:
+            sig = g1_from_bytes(b58decode(signature_b58))
+        except ValueError:
+            return False
+        pk = _g2_checked(pk_b58)
+        if sig is None or pk is None:
+            return False
+        # e(H(m), pk) == e(sig, G2) <=> e(H(m), pk) * e(-sig, G2) == 1
+        return bn.pairing_check([
+            (hash_to_g1(message), pk),
+            (bn.g1_neg(sig), bn.G2_GEN),
+        ])
+
+    @staticmethod
+    def verify_pop(pop_b58: str, pk_b58: str) -> bool:
+        try:
+            pk_bytes = b58decode(pk_b58)
+            g2_from_bytes(pk_bytes)
+        except ValueError:
+            return False
+        return BlsCryptoVerifier.verify_sig(pop_b58, pk_bytes, pk_b58)
+
+    @staticmethod
+    def aggregate_sigs(signatures_b58: Sequence[str]) -> str:
+        acc: bn.G1Point = None
+        for s in signatures_b58:
+            acc = bn.g1_add(acc, g1_from_bytes(b58decode(s)))
+        return b58encode(g1_to_bytes(acc))
+
+    @staticmethod
+    def verify_multi_sig(signature_b58: str, message: bytes,
+                         pks_b58: Sequence[str]) -> bool:
+        try:
+            sig = g1_from_bytes(b58decode(signature_b58))
+        except ValueError:
+            return False
+        acc: bn.G2Point = None
+        for pk in pks_b58:
+            p = _g2_checked(pk)
+            if p is None:
+                return False
+            acc = bn.g2_add(acc, p)
+        if sig is None or acc is None:
+            return False
+        return bn.pairing_check([
+            (hash_to_g1(message), acc),
+            (bn.g1_neg(sig), bn.G2_GEN),
+        ])
+
+
+# --- multi-signature value objects ----------------------------------------
+
+
+class MultiSignatureValue:
+    """What the pool actually co-signs: the committed roots at a 3PC batch.
+
+    Reference: crypto/bls/bls_multi_signature.py (`MultiSignatureValue`).
+    """
+
+    FIELDS = ("ledger_id", "state_root_hash", "pool_state_root_hash",
+              "txn_root_hash", "timestamp")
+
+    def __init__(self, ledger_id: int, state_root_hash: str,
+                 pool_state_root_hash: str, txn_root_hash: str,
+                 timestamp: int):
+        self.ledger_id = ledger_id
+        self.state_root_hash = state_root_hash
+        self.pool_state_root_hash = pool_state_root_hash
+        self.txn_root_hash = txn_root_hash
+        self.timestamp = timestamp
+
+    def as_dict(self) -> Dict:
+        return {k: getattr(self, k) for k in self.FIELDS}
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "MultiSignatureValue":
+        return cls(**{k: data[k] for k in cls.FIELDS})
+
+    def serialize(self) -> bytes:
+        from ...common.serializers.serialization import serialize_for_signing
+
+        return serialize_for_signing(self.as_dict())
+
+    def __eq__(self, other):
+        return isinstance(other, MultiSignatureValue) \
+            and self.as_dict() == other.as_dict()
+
+
+class MultiSignature:
+    """signature + participants + signed value (reference: MultiSignature)."""
+
+    def __init__(self, signature: str, participants: List[str],
+                 value: MultiSignatureValue):
+        self.signature = signature
+        self.participants = list(participants)
+        self.value = value
+
+    def as_dict(self) -> Dict:
+        return {"signature": self.signature,
+                "participants": self.participants,
+                "value": self.value.as_dict()}
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "MultiSignature":
+        return cls(data["signature"], list(data["participants"]),
+                   MultiSignatureValue.from_dict(dict(data["value"])))
+
+    def __eq__(self, other):
+        return isinstance(other, MultiSignature) \
+            and self.as_dict() == other.as_dict()

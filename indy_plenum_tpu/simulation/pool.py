@@ -143,7 +143,7 @@ class SimNode:
                  network: SimNetwork, requests: SimRequestsPool,
                  config: Config, device_quorum: bool = False,
                  domain_genesis: Optional[list] = None,
-                 storage=None):
+                 storage=None, bls_keys=None):
         self.name = name
         self.config = config
         self.data = ConsensusSharedData(
@@ -182,12 +182,32 @@ class SimNode:
                 validators, log_size=config.LOG_SIZE,
                 n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
 
+        self.bls_replica = None
+        if bls_keys is not None:
+            from ..bls.factory import create_bls_bft_replica
+            from ..utils.base58 import b58encode
+
+            own_kp, pool_keys = bls_keys[name], {
+                n: (pk, pop) for n, (kp, pk, pop) in bls_keys.items()}
+
+            def pool_root():
+                if self.boot is None:
+                    return ""
+                from ..common.constants import POOL_LEDGER_ID
+
+                return b58encode(self.boot.db.get_state(
+                    POOL_LEDGER_ID).committed_head_hash)
+
+            self.bls_replica = create_bls_bft_replica(
+                name, own_kp[0], pool_keys,
+                pool_state_root_provider=pool_root)
+
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher,
             executor=self.executor, requests=self.requests_view,
             config=config, vote_plane=self.vote_plane,
-            shadow_check=device_quorum)
+            shadow_check=device_quorum, bls=self.bls_replica)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher, config=config,
@@ -223,6 +243,25 @@ class SimNode:
         self.ordered_log.append(ordered)
         self.executor.commit_batch(ordered.ppSeqNo)
 
+    def read_nym_with_proof(self, did: str):
+        """Proved read from THIS node alone (requires real_execution+bls):
+        value + SMT inclusion proof + the pool's multi-sig over the root."""
+        from ..client.state_proof import StateProofReply
+        from ..common.constants import DOMAIN_LEDGER_ID
+        from ..utils.base58 import b58encode
+
+        state = self.boot.db.get_state(DOMAIN_LEDGER_ID)
+        root = state.committed_head_hash
+        key = did.encode()
+        value = state.get(key, is_committed=True)
+        proof = state.generate_state_proof(key, root=root, serialize=True)
+        ms = None
+        if self.bls_replica is not None:
+            found = self.bls_replica.store.get(b58encode(root))
+            ms = found.as_dict() if found else None
+        return StateProofReply(key=key, value=value, root=root,
+                               proof=proof, multi_sig_dict=ms)
+
     @property
     def ordered_digests(self) -> List[str]:
         out = []
@@ -236,7 +275,8 @@ class SimPool:
                  config: Optional[Config] = None,
                  device_quorum: bool = False,
                  real_execution: bool = False,
-                 sign_requests: bool = False):
+                 sign_requests: bool = False,
+                 bls: bool = False):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -268,10 +308,20 @@ class SimPool:
                 self.trustee.identifier: self.trustee.verkey})
         self._ingress: List[Request] = []
 
+        self.bls_keys = None
+        if bls:
+            from ..bls.factory import generate_bls_keys
+
+            self.bls_keys = {
+                name: generate_bls_keys(
+                    hashlib.sha256(b"sim-bls-" + name.encode()).digest())
+                for name in self.validators}
+
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
                     self.requests, self.config, device_quorum=device_quorum,
-                    domain_genesis=domain_genesis if real_execution else None)
+                    domain_genesis=domain_genesis if real_execution else None,
+                    bls_keys=self.bls_keys)
             for name in self.validators]
         self.network.connect_all()
 

@@ -24,13 +24,17 @@ import logging
 from typing import Dict, Optional, Tuple
 
 from ..common.exceptions import SuspiciousNode
+from ..crypto.bls import bn254 as bn
 from ..crypto.bls.bls_crypto import (
     BlsCryptoSigner,
     BlsCryptoVerifier,
     MultiSignature,
     MultiSignatureValue,
+    g1_from_bytes,
+    g1_to_bytes,
 )
 from ..server.suspicion_codes import Suspicions
+from ..utils.base58 import b58decode, b58encode
 from .bls_key_register import BlsKeyRegister
 from .bls_store import BlsStore
 
@@ -43,13 +47,17 @@ class BlsBftReplica:
                  signer: BlsCryptoSigner,
                  key_register: BlsKeyRegister,
                  store: Optional[BlsStore] = None,
-                 pool_state_root_provider=None):
+                 pool_state_root_provider=None,
+                 suspicion_sink=None):
         self._name = node_name
         self._signer = signer
         self._verifier = BlsCryptoVerifier()
         self._register = key_register
         self._store = store if store is not None else BlsStore()
         self._pool_root = pool_state_root_provider or (lambda: "")
+        # called with a SuspiciousNode when the culprit re-check identifies
+        # a bad signer (process_order cannot raise: ordering must proceed)
+        self._suspicion_sink = suspicion_sink or (lambda ex: None)
         # (view_no, pp_seq_no) -> sender -> sig b58
         self._sigs: Dict[Tuple[int, int], Dict[str, str]] = {}
         self._latest_multi_sig: Optional[MultiSignature] = None
@@ -110,11 +118,24 @@ class BlsBftReplica:
         return params
 
     def validate_commit(self, commit, sender, pp) -> None:
-        # optimistic: defer pairing checks to aggregation (see module doc).
-        # Structural sanity only — a missing signature is fine (not every
-        # node must have BLS keys), garbage strings are dropped here.
+        # optimistic: defer PAIRING checks to aggregation (see module doc),
+        # but a signature must at least decode to a canonical on-curve G1
+        # point — otherwise one byzantine COMMIT would make aggregate_sigs
+        # raise at ordering time on every honest node. A missing signature
+        # is fine (not every node must have BLS keys).
         sig = getattr(commit, "blsSig", None)
-        if sig is not None and not isinstance(sig, str):
+        if sig is None:
+            return
+        if not isinstance(sig, str):
+            raise SuspiciousNode(sender, Suspicions.CM_BLS_WRONG)
+        try:
+            pt = g1_from_bytes(b58decode(sig))
+        except (ValueError, KeyError):
+            raise SuspiciousNode(sender, Suspicions.CM_BLS_WRONG) from None
+        if pt is None:
+            # the identity encoding: contributes nothing to the aggregate
+            # but would fail the aggregate check every batch, forcing the
+            # per-signer culprit scan on the ordering hot path
             raise SuspiciousNode(sender, Suspicions.CM_BLS_WRONG)
 
     def process_commit(self, commit, sender) -> None:
@@ -134,13 +155,35 @@ class BlsBftReplica:
         # include our own signature (we signed in update_commit only if we
         # sent a COMMIT; recompute — signing is cheap, one G1 mul)
         sigs[self._name] = self._signer.sign(value.serialize())
-        if not quorums.bls_signatures.is_reached(len(sigs)):
+        # decode each signature exactly ONCE and aggregate the points
+        # directly. validate_commit guarantees stored sigs decode to
+        # non-identity points, but a raise here would desync execution on
+        # every honest node, so drop failures instead of propagating.
+        points: Dict[str, object] = {}
+        for p, s in sigs.items():
+            try:
+                pt = g1_from_bytes(b58decode(s))
+            except (ValueError, KeyError):
+                pt = None
+            if pt is None:
+                logger.warning("%s: dropping bad BLS sig from %s at %s",
+                               self._name, p, key)
+                continue
+            points[p] = pt
+        if not quorums.bls_signatures.is_reached(len(points)):
             logger.debug("%s: no BLS quorum for %s (%d sigs)", self._name,
-                         key, len(sigs))
+                         key, len(points))
             return
-        participants = sorted(sigs)
+        participants = sorted(points)
         message = value.serialize()
-        agg = self._verifier.aggregate_sigs([sigs[p] for p in participants])
+
+        def _aggregate(names):
+            acc = None
+            for nm in names:
+                acc = bn.g1_add(acc, points[nm])
+            return b58encode(g1_to_bytes(acc))
+
+        agg = _aggregate(participants)
         pks = self._register.get_keys(participants)
         if pks is None:
             return
@@ -151,14 +194,18 @@ class BlsBftReplica:
                 pk = self._register.get_key(p)
                 if pk and self._verifier.verify_sig(sigs[p], message, pk):
                     good.append(p)
+                elif p == self._name:
+                    logger.error("%s: OWN BLS sig failed verification at %s",
+                                 self._name, key)
                 else:
                     logger.warning("%s: invalid BLS sig from %s at %s",
                                    self._name, p, key)
+                    self._suspicion_sink(
+                        SuspiciousNode(p, Suspicions.CM_BLS_WRONG))
             if not quorums.bls_signatures.is_reached(len(good)):
                 return
             participants = good
-            agg = self._verifier.aggregate_sigs(
-                [sigs[p] for p in participants])
+            agg = _aggregate(participants)
         ms = MultiSignature(signature=agg, participants=participants,
                             value=value)
         self._store.put(ms)

@@ -20,7 +20,8 @@ def create_bls_bft_replica(node_name: str,
                            keypair: BlsKeyPair,
                            pool_keys: Dict[str, Tuple[str, str]],
                            store: Optional[BlsStore] = None,
-                           pool_state_root_provider=None) -> BlsBftReplica:
+                           pool_state_root_provider=None,
+                           suspicion_sink=None) -> BlsBftReplica:
     """pool_keys: node name -> (pk_b58, pop_b58); PoP verified on load."""
     register = BlsKeyRegister()
     for name, (pk, pop) in pool_keys.items():
@@ -31,4 +32,5 @@ def create_bls_bft_replica(node_name: str,
         key_register=register,
         store=store,
         pool_state_root_provider=pool_state_root_provider,
+        suspicion_sink=suspicion_sink,
     )

@@ -37,6 +37,7 @@ class Config:
 
     # --- view change ------------------------------------------------------
     ToleratePrimaryDisconnection: float = 2.0  # seconds
+    OldViewPPRequestInterval: float = 1.0  # re-fetch missing old-view PPs
     NewViewTimeout: float = 30.0  # restart VC with v+1 if not completed
     ViewChangeResendInterval: float = 10.0
     INSTANCE_CHANGE_TIMEOUT: float = 300.0  # discard stale instance changes

@@ -35,6 +35,11 @@ def g1_from_bytes(data: bytes) -> bn.G1Point:
     if data == b"\x00" * 64:
         return None
     pt = (int.from_bytes(data[:32], "big"), int.from_bytes(data[32:], "big"))
+    # canonical encodings only: a coordinate >= P would alias another point
+    # mod P, giving one signature several distinct wire forms (malleability
+    # breaking digest-based dedup and the b58-keyed subgroup cache)
+    if pt[0] >= bn.P or pt[1] >= bn.P:
+        raise ValueError("non-canonical G1 coordinate")
     if not bn.g1_is_on_curve(pt):
         raise ValueError("point not on G1")
     return pt
@@ -53,6 +58,8 @@ def g2_from_bytes(data: bytes) -> bn.G2Point:
     if data == b"\x00" * 128:
         return None
     vals = [int.from_bytes(data[i:i + 32], "big") for i in range(0, 128, 32)]
+    if any(v >= bn.P for v in vals):
+        raise ValueError("non-canonical G2 coordinate")
     pt = ((vals[0], vals[1]), (vals[2], vals[3]))
     if not bn.g2_is_on_curve(pt):
         raise ValueError("point not on E'")

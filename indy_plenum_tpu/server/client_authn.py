@@ -123,35 +123,51 @@ class CoreAuthNr(ClientAuthNr):
     def authenticate_batch(self, reqs: Sequence[Request]) -> np.ndarray:
         """Device-verify a request batch; (B,) bool verdicts.
 
-        Requests whose verkey cannot be resolved or whose signature is
-        structurally invalid fail without touching the device; the rest are
-        verified in one jitted kernel call (bucketed padding).
+        Every attached signature — the single ``signature`` AND each
+        multi-sig endorsement in ``signatures`` — becomes one batch entry;
+        a request verifies only if ALL of its entries verify (reference:
+        ReqAuthenticator verifies every attached signature). Requests whose
+        verkey cannot be resolved or whose signature is structurally
+        invalid fail without touching the device; the rest are verified in
+        one jitted kernel call (bucketed padding).
         """
         from ..tpu import ed25519 as ted
 
         n = len(reqs)
         verdict = np.zeros(n, bool)
-        idx, pks, msgs, sigs = [], [], [], []
+        entry_req: List[int] = []  # owning request index per entry
+        pks, msgs, sigs = [], [], []
+        candidate = np.zeros(n, bool)
         for i, req in enumerate(reqs):
-            if not req.signature:
-                continue  # multi-sig-only requests take the host path
-            vk = self.resolve_verkey(req.identifier)
-            if vk is None:
+            pairs = dict(req.signatures or {})
+            if req.signature:
+                pairs.setdefault(req.identifier, req.signature)
+            if not pairs:
                 continue
-            try:
-                sig = b58decode(req.signature)
-            except ValueError:
-                continue
-            if len(sig) != 64:
-                continue
-            idx.append(i)
-            pks.append(vk)
-            msgs.append(req.signing_bytes())
-            sigs.append(sig)
-        if not idx:
+            data = req.signing_bytes()
+            local = []
+            for idr in sorted(pairs):
+                vk = self.resolve_verkey(idr)
+                if vk is None:
+                    break
+                try:
+                    sig = b58decode(pairs[idr])
+                except ValueError:
+                    break
+                if len(sig) != 64:
+                    break
+                local.append((vk, sig))
+            else:
+                candidate[i] = True
+                for vk, sig in local:
+                    entry_req.append(i)
+                    pks.append(vk)
+                    msgs.append(data)
+                    sigs.append(sig)
+        if not entry_req:
             return verdict
 
-        m = len(idx)
+        m = len(entry_req)
         size = _bucket(m)
         pad = size - m
         pks += [pks[0]] * pad
@@ -159,8 +175,9 @@ class CoreAuthNr(ClientAuthNr):
         sigs += [sigs[0]] * pad
         pk_a, r_a, s_a, h_a, pre = ted.prepare_batch(pks, msgs, sigs)
         ok = np.asarray(ted.verify_kernel(pk_a, r_a, s_a, h_a)) & pre
-        verdict[np.asarray(idx)] = ok[:m]
-        return verdict
+        owners = np.asarray(entry_req)
+        bad_per_req = np.bincount(owners[~ok[:m]], minlength=n)
+        return candidate & (bad_per_req == 0)
 
 
 class ReqAuthenticator:

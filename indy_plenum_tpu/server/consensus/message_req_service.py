@@ -169,7 +169,9 @@ class MessageReqService:
     def _batch_digest_of(pp: PrePrepare) -> str:
         from .ordering_service import OrderingService
 
-        return OrderingService._batch_digest(list(pp.reqIdr))
+        return OrderingService._batch_digest(
+            list(pp.reqIdr), pp.ppTime, pp.stateRootHash, pp.txnRootHash,
+            pp.ledgerId, pp.discarded)
 
     # --- inbound responses ---------------------------------------------
 

@@ -201,6 +201,12 @@ class OrderingService:
         self._batch_timer = RepeatingTimer(
             timer, self._config.Max3PCBatchWait, self._on_batch_timer,
             active=False)
+        # liveness: a lost OLD_VIEW_PREPREPARE response must not leave the
+        # node (or a mute primary) waiting forever — re-request periodically
+        # until every pending NEW_VIEW-selected batch is fetched
+        self._fetch_timer = RepeatingTimer(
+            timer, self._config.OldViewPPRequestInterval,
+            self._refetch_pending_old_view_pps, active=False)
 
     # ------------------------------------------------------------------
     # primary: batch creation
@@ -224,6 +230,9 @@ class OrderingService:
         return (self._data.is_primary_in_view
                 and self._data.is_participating
                 and not self._data.waiting_for_new_view
+                # NEW_VIEW-selected batches still being fetched own their
+                # seqNos; minting a fresh batch now would collide with them
+                and not self._pending_old_view_bids
                 and self._data.pp_seq_no < self._data.high_watermark)
 
     def _on_batch_timer(self) -> None:
@@ -246,9 +255,11 @@ class OrderingService:
         pp_time = int(self._get_time())
         self._data.pp_seq_no += 1
         state_root = txn_root = None
+        discarded = 0
         if self._is_master and self._executor is not None:
             state_root, txn_root = self._executor.apply_batch(
                 reqs, ledger_id, pp_time, self._data.pp_seq_no)
+            discarded = len(getattr(self._executor, "last_rejected", []))
             self._last_applied_seq = max(self._last_applied_seq,
                                          self._data.pp_seq_no)
         params = dict(
@@ -257,8 +268,10 @@ class OrderingService:
             ppSeqNo=self._data.pp_seq_no,
             ppTime=pp_time,
             reqIdr=[r.digest for r in reqs],
-            discarded=0,
-            digest=self._batch_digest([r.digest for r in reqs]),
+            discarded=discarded,
+            digest=self._batch_digest([r.digest for r in reqs], pp_time,
+                                      state_root, txn_root, ledger_id,
+                                      discarded),
             ledgerId=ledger_id,
             stateRootHash=state_root,
             txnRootHash=txn_root,
@@ -280,10 +293,19 @@ class OrderingService:
         return pp
 
     @staticmethod
-    def _batch_digest(req_digests: List[str]) -> str:
+    def _batch_digest(req_digests: List[str], pp_time=None,
+                      state_root=None, txn_root=None, ledger_id=None,
+                      discarded=None) -> str:
+        """Digest binding the FULL batch content: request ids, ppTime, both
+        roots, the ledger and the discarded count. Because PREPARE/COMMIT
+        and NEW_VIEW BatchIDs carry this digest, a fetched
+        OLD_VIEW_PREPREPARE with ANY field forged by the responder cannot
+        match it (advisor r2 finding)."""
         import hashlib
 
-        payload = "".join(req_digests).encode()
+        payload = "|".join(
+            ["".join(req_digests), str(pp_time), str(state_root),
+             str(txn_root), str(ledger_id), str(discarded)]).encode()
         return hashlib.sha256(payload).hexdigest()
 
     # ------------------------------------------------------------------
@@ -339,7 +361,9 @@ class OrderingService:
                 self._bus.send(RequestPropagates(missing))
                 return STASH_WAITING_REQUESTS, f"missing {len(missing)} reqs"
 
-        if pp.digest != self._batch_digest(list(pp.reqIdr)):
+        if pp.digest != self._batch_digest(list(pp.reqIdr), pp.ppTime,
+                                           pp.stateRootHash, pp.txnRootHash,
+                                           pp.ledgerId, pp.discarded):
             self._raise_suspicion(sender, Suspicions.PPR_DIGEST_WRONG)
             return DISCARD, "digest mismatch"
 
@@ -364,6 +388,13 @@ class OrderingService:
                 self._executor.revert_batches(pp.ledgerId, 1)
                 self._raise_suspicion(sender, Suspicions.PPR_TXN_WRONG)
                 return DISCARD, "txn root mismatch"
+            # the rejection split is deterministic: a primary lying about
+            # the discarded count cannot hide behind matching roots
+            my_discarded = len(getattr(self._executor, "last_rejected", []))
+            if pp.ppSeqNo > committed and pp.discarded != my_discarded:
+                self._executor.revert_batches(pp.ledgerId, 1)
+                self._raise_suspicion(sender, Suspicions.PPR_DISCARDED_WRONG)
+                return DISCARD, "discarded count mismatch"
             self._last_applied_seq = max(floor, pp.ppSeqNo)
 
         self.prePrepares[key] = pp
@@ -591,6 +622,7 @@ class OrderingService:
             # old-view votes are void; slots refill during re-ordering
             self._vote_plane.reset(h=self._data.low_watermark)
         self._pending_old_view_bids.clear()
+        self._fetch_timer.stop()
         self.sent_preprepares.clear()
         self.prePrepares.clear()
         self.prepares.clear()
@@ -630,7 +662,20 @@ class OrderingService:
                     dst=None))
                 continue
             self._apply_new_view_batch(old_pp, msg.view_no, pp_view_no)
+        if self._pending_old_view_bids:
+            self._fetch_timer.start()
         self._stasher.process_all_stashed()
+
+    def _refetch_pending_old_view_pps(self) -> None:
+        if not self._pending_old_view_bids:
+            self._fetch_timer.stop()
+            return
+        for old_key in list(self._pending_old_view_bids):
+            self._bus.send(MissingMessage(
+                msg_type="OLD_VIEW_PREPREPARE",
+                key=old_key,
+                inst_id=self._data.inst_id,
+                dst=None))
 
     def _apply_new_view_batch(self, old_pp: PrePrepare, new_view_no: int,
                               orig_view_no: int) -> None:
@@ -641,19 +686,18 @@ class OrderingService:
         new_pp = PrePrepare(**params)
         self._data.pp_seq_no = max(self._data.pp_seq_no, new_pp.ppSeqNo)
         if self._data.is_primary_in_view:
-            key = (new_pp.viewNo, new_pp.ppSeqNo)
-            self.sent_preprepares[key] = new_pp
-            self.prePrepares[key] = new_pp
-            self.batches[key] = new_pp.ledgerId
-            self._data.preprepare_batch(preprepare_to_batch_id(new_pp))
-            if self._vote_plane is not None:
-                self._vote_plane.record_preprepare(new_pp.ppSeqNo)
+            self.sent_preprepares[(new_pp.viewNo, new_pp.ppSeqNo)] = new_pp
             self._network.send(new_pp)
-            self._try_prepared(key)
-        else:
-            # through the stasher: out-of-order/early verdicts must stash,
-            # not vanish (a direct handler call would drop the verdict)
-            self._stasher.process(new_pp, self._data.primary_name)
+            # these requests are owned by the re-keyed batch now; minting a
+            # fresh batch from them later would double-order them
+            if self._requests is not None:
+                self._requests.mark_ordered(list(new_pp.reqIdr))
+        # BOTH primary and replicas run the normal PP path through the
+        # stasher: the primary must re-APPLY the batch (its speculative
+        # state was reverted at view-change start) under the same in-order
+        # discipline, and out-of-order/early verdicts must stash, not
+        # vanish (a direct handler call would drop the verdict)
+        self._stasher.process(new_pp, self._data.primary_name)
 
     def process_requested_old_view_pp(self, pp: PrePrepare) -> None:
         """A fetched old-view PrePrepare arrived (MessageReqService validated
@@ -663,6 +707,8 @@ class OrderingService:
         old_key = (orig, pp.ppSeqNo, pp.digest)
         self.old_view_preprepares[old_key] = pp
         new_view_no = self._pending_old_view_bids.pop(old_key, None)
+        if not self._pending_old_view_bids:
+            self._fetch_timer.stop()
         if new_view_no is None or new_view_no != self._data.view_no:
             return  # no longer waiting (another view change happened)
         self._apply_new_view_batch(pp, new_view_no, orig)

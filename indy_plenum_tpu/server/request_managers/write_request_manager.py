@@ -91,32 +91,63 @@ class WriteRequestManager:
         handler.update_state(txn, None, request=request)
         return txn
 
-    def apply_batch(self, batch: ThreePcBatch,
-                    reqs: List[Request]) -> Tuple[bytes, bytes]:
+    def apply_batch(self, batch: ThreePcBatch, reqs: List[Request]
+                    ) -> Tuple[bytes, bytes, List[Tuple[Request, Exception]]]:
         """Speculatively apply a whole 3PC batch; returns the raw
-        (state_root, txn_root) every replica must reproduce."""
+        (state_root, txn_root) every replica must reproduce plus the
+        requests rejected by dynamic validation.
+
+        Validation is interleaved with application in request order, so the
+        valid/invalid split is a deterministic function of (pre-state,
+        request sequence): every replica re-running this loop reaches the
+        same split and the same roots. A rejected request is simply not
+        applied (the reference tracks these via the PRE-PREPARE ``discarded``
+        field and sends Rejects at execution); an *unexpected* failure rolls
+        the ledger and state back to the pre-batch roots and re-raises —
+        never leave half a batch applied without a staged record.
+        """
+        from ...common.exceptions import InvalidClientRequest
+
         ledger = self.db.get_ledger(batch.ledger_id)
         state = self.db.get_state(batch.ledger_id)
         pre_state_root = state.head_hash if state is not None else None
-        for req in reqs:
-            self.dynamic_validation(req, batch.pp_time)
-            self.apply_request(req, batch.pp_time)
+        pre_uncommitted = ledger.uncommitted_size
+        valid: List[Request] = []
+        rejected: List[Tuple[Request, Exception]] = []
+        try:
+            for req in reqs:
+                try:
+                    self.dynamic_validation(req, batch.pp_time)
+                except InvalidClientRequest as ex:
+                    rejected.append((req, ex))
+                    continue
+                self.apply_request(req, batch.pp_time)
+                valid.append(req)
+        except Exception:
+            # discard down to the pre-batch size, not len(valid): the
+            # failing request's txn may already be appended (apply_request
+            # appends before update_state runs)
+            ledger.discard_txns(ledger.uncommitted_size - pre_uncommitted)
+            if state is not None and pre_state_root is not None:
+                state.set_head_hash(pre_state_root)
+            raise
         state_root = state.head_hash if state is not None else b""
         txn_root = ledger.uncommitted_root_hash
         batch.state_root = state_root
         batch.txn_root = txn_root
+        batch.valid_digests = [r.digest for r in valid]
         if self.audit_handler is not None:
             self.audit_handler.post_batch_applied(batch)
         self._staged.append(StagedBatch(
             ledger_id=batch.ledger_id,
             pp_seq_no=batch.pp_seq_no,
             view_no=batch.view_no,
-            txn_count=len(reqs),
+            txn_count=len(valid),
             pre_state_root=pre_state_root,
             state_root=state_root,
             batch=batch,
         ))
-        return state_root, txn_root
+        return state_root, txn_root, rejected
 
     # --- revert (LIFO) --------------------------------------------------
 
@@ -177,10 +208,15 @@ class NodeExecutor:
     def __init__(self, manager: WriteRequestManager, get_view_info=None):
         self.manager = manager
         self._get_view_info = get_view_info or (lambda: (0, []))
+        # requests the last apply_batch rejected in dynamic validation —
+        # the ordering service reads this to fill PrePrepare.discarded (on
+        # the primary) and to cross-check it on re-apply (replicas)
+        self.last_rejected: List[Tuple[Request, Exception]] = []
 
     def apply_batch(self, reqs: List[Request], ledger_id: int,
                     pp_time: int, pp_seq_no: int
                     ) -> Tuple[Optional[str], Optional[str]]:
+        self.last_rejected = []
         committed = self.committed_seq()
         if pp_seq_no <= committed:
             # historical: already durably executed (pre-view-change); the
@@ -203,7 +239,8 @@ class NodeExecutor:
             valid_digests=[r.digest for r in reqs],
             primaries=primaries,
         )
-        state_root, txn_root = self.manager.apply_batch(batch, reqs)
+        state_root, txn_root, rejected = self.manager.apply_batch(batch, reqs)
+        self.last_rejected = rejected
         return b58encode(state_root), b58encode(txn_root)
 
     def revert_batches(self, ledger_id: int, count: int) -> None:

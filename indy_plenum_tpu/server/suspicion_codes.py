@@ -31,6 +31,8 @@ class Suspicions:
     CM_BLS_WRONG = Suspicion(14, "COMMIT BLS signature invalid")
     PPR_BLS_MULTISIG_WRONG = Suspicion(15, "PRE-PREPARE BLS multi-sig invalid")
     PPR_AUDIT_TXN_ROOT_WRONG = Suspicion(16, "PRE-PREPARE audit root mismatch")
+    PPR_DISCARDED_WRONG = Suspicion(
+        17, "PRE-PREPARE discarded count mismatch on re-apply")
     INSTANCE_CHANGE_SPOOFED = Suspicion(20, "INSTANCE_CHANGE signature bad")
     VIEW_CHANGE_WRONG = Suspicion(21, "VIEW_CHANGE malformed or inconsistent")
     NEW_VIEW_INVALID = Suspicion(22, "NEW_VIEW does not match VIEW_CHANGEs")

@@ -198,9 +198,17 @@ class SimNode:
                 return b58encode(self.boot.db.get_state(
                     POOL_LEDGER_ID).committed_head_hash)
 
+            def bls_suspicion(ex):
+                from ..common.messages.internal_messages import (
+                    RaisedSuspicion,
+                )
+
+                self.internal_bus.send(RaisedSuspicion(inst_id=0, ex=ex))
+
             self.bls_replica = create_bls_bft_replica(
                 name, own_kp[0], pool_keys,
-                pool_state_root_provider=pool_root)
+                pool_state_root_provider=pool_root,
+                suspicion_sink=bls_suspicion)
 
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,

@@ -21,6 +21,33 @@ V = BlsCryptoVerifier()
 # --- tier 1: curve + pairing ----------------------------------------------
 
 
+def test_non_canonical_encodings_rejected():
+    """Advisor r2 (low): coordinates >= P must be rejected — otherwise one
+    point has many wire forms (signature malleability breaking dedup and
+    the b58-keyed subgroup cache identity)."""
+    from indy_plenum_tpu.crypto.bls.bls_crypto import (
+        g1_from_bytes,
+        g1_to_bytes,
+        g2_from_bytes,
+        g2_to_bytes,
+    )
+
+    g1 = g1_to_bytes(bn.G1_GEN)
+    assert g1_from_bytes(g1) == bn.G1_GEN
+    aliased_x = (bn.G1_GEN[0] + bn.P).to_bytes(32, "big") + g1[32:]
+    with pytest.raises(ValueError):
+        g1_from_bytes(aliased_x)
+    aliased_y = g1[:32] + (bn.G1_GEN[1] + bn.P).to_bytes(32, "big")
+    with pytest.raises(ValueError):
+        g1_from_bytes(aliased_y)
+
+    g2 = g2_to_bytes(bn.G2_GEN)
+    assert g2_from_bytes(g2) == bn.G2_GEN
+    aliased = (bn.G2_GEN[0][0] + bn.P).to_bytes(32, "big") + g2[32:]
+    with pytest.raises(ValueError):
+        g2_from_bytes(aliased)
+
+
 def test_generators_and_orders():
     assert bn.g1_is_on_curve(bn.G1_GEN)
     assert bn.g2_is_on_curve(bn.G2_GEN)

@@ -34,6 +34,25 @@ def test_early_prepare_with_bogus_digest_does_not_count():
     assert pool.honest_nodes_agree()
 
 
+def test_malformed_bls_sig_in_commit_does_not_crash_ordering():
+    """Advisor r2 (high): a COMMIT carrying a garbage blsSig string used to
+    pass validate_commit and crash aggregate_sigs inside ordering on every
+    honest node. It must be discarded, and ordering must proceed."""
+    from indy_plenum_tpu.common.messages.node_messages import Commit
+    from indy_plenum_tpu.simulation.pool import SimPool as BlsSimPool
+
+    pool = BlsSimPool(4, seed=13, real_execution=True, bls=True)
+    node1 = pool.node("node1")
+    # bad base58 / wrong length / off-curve all decode-fail; use bad b58
+    evil = Commit(instId=0, viewNo=0, ppSeqNo=1,
+                  blsSig="0OIl-not-base58")
+    node1.external_bus.process_incoming(evil, "node3")
+    pool.submit_request(0)
+    pool.run_for(10)
+    assert len(node1.ordered_digests) == 1
+    assert pool.honest_nodes_agree()
+
+
 def test_byzantine_wrong_digest_prepare_cannot_block_honest_quorum():
     # the evil vote squats node3's slot but honest n-f-1 others still prepare
     pool = SimPool(4, seed=12)

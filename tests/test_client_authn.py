@@ -132,6 +132,46 @@ def test_authenticate_batch_device_matches_host():
     assert not verdict[3] and not verdict[5] and not verdict[7]
 
 
+def test_authenticate_batch_verifies_multisig_endorsements():
+    """Every attached signature is an entry: a request with a bad
+    endorsement fails even if the primary signature is good, and a
+    multi-sig-only request verifies on the device path (advisor r2)."""
+    db, handler = make_domain()
+    signers = [DidSigner(s) for s in SEEDS[:4]]
+    for i, s in enumerate(signers):
+        write_nym(handler, s, i + 1)
+    authnr = CoreAuthNr(verkey_source=handler)
+
+    # 0: single-sig good; 1: single + good endorsement; 2: single good +
+    # endorsement FORGED; 3: multi-sig only (no single signature)
+    reqs = []
+    for i in range(4):
+        r = Request(reqId=200 + i,
+                    operation={TXN_TYPE: NYM, TARGET_NYM: "X", "v": i})
+        reqs.append(r)
+    signers[0].sign_request(reqs[0])
+    signers[0].sign_request(reqs[1])
+    signers[1].endorse_request(reqs[1])
+    signers[0].sign_request(reqs[2])
+    signers[1].endorse_request(reqs[2])
+    # forge: swap in a signature over different bytes
+    reqs[2].signatures[signers[1].identifier] = \
+        reqs[1].signatures[signers[1].identifier]
+    reqs[3].identifier = signers[2].identifier
+    signers[2].endorse_request(reqs[3])
+    signers[3].endorse_request(reqs[3])
+
+    verdict = authnr.authenticate_batch(reqs)
+    assert verdict.tolist() == [True, True, False, True]
+    # host oracle agrees
+    for r, v in zip(reqs, verdict):
+        try:
+            authnr.authenticate(r)
+            assert v
+        except Exception:
+            assert not v
+
+
 def test_req_authenticator_registry():
     signer = SimpleSigner(SEEDS[5])
     ra = ReqAuthenticator()

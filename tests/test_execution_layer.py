@@ -105,7 +105,9 @@ def test_historical_roots_below_committed():
     assert not boot.write_manager.staged_batches
 
 
-def test_dynamic_validation_enforced():
+def test_dynamic_validation_discards_deterministically():
+    """An invalid request is discarded from the batch, not applied — and it
+    does not corrupt the roots of valid requests applied around it."""
     from indy_plenum_tpu.common.exceptions import UnauthorizedClientRequest
 
     boot = make_bootstrap()
@@ -114,8 +116,23 @@ def test_dynamic_validation_enforced():
     evil = Request(identifier=nobody.identifier, reqId=1,
                    operation={TXN_TYPE: NYM, TARGET_NYM: nobody.identifier,
                               VERKEY: nobody.verkey})
-    with pytest.raises(UnauthorizedClientRequest):
-        ex.apply_batch([evil], DOMAIN_LEDGER_ID, T0, 1)
+    good, _ = nym_request(7)
+    pre_root = boot.db.get_state(DOMAIN_LEDGER_ID).head_hash
+    ex.apply_batch([good, evil], DOMAIN_LEDGER_ID, T0, 1)
+    assert len(ex.last_rejected) == 1
+    assert ex.last_rejected[0][0] is evil
+    assert isinstance(ex.last_rejected[0][1], UnauthorizedClientRequest)
+    staged = boot.write_manager.staged_batches[-1]
+    assert staged.txn_count == 1  # only the valid request was applied
+    assert staged.batch.valid_digests == [good.digest]
+    # and an all-invalid batch leaves the state root untouched
+    boot2 = make_bootstrap()
+    ex2 = NodeExecutor(boot2.write_manager)
+    pre_root2 = boot2.db.get_state(DOMAIN_LEDGER_ID).head_hash
+    roots = ex2.apply_batch([evil], DOMAIN_LEDGER_ID, T0, 1)
+    assert boot2.db.get_state(DOMAIN_LEDGER_ID).head_hash == pre_root2
+    assert len(ex2.last_rejected) == 1
+    assert pre_root == pre_root2  # same genesis
 
 
 def test_restart_resumes_at_committed_height():

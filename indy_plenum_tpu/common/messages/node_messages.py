@@ -239,12 +239,25 @@ class CatchupReq(MessageBase):
 
 @register
 class CatchupRep(MessageBase):
+    """Txn range + per-txn audit paths.
+
+    TPU-first redesign: the reference's CatchupRep carries one consistency
+    proof per rep, verified by an incremental host-side tree fold; here
+    EVERY txn carries its own audit path against the quorum-agreed target
+    root, so one vmapped device kernel call
+    (:func:`indy_plenum_tpu.tpu.sha256.verify_audit_paths`) verifies the
+    whole slice — BASELINE config 5's batched proof verification.
+    """
+
     typename = "CATCHUP_REP"
     schema = (
         ("ledgerId", LedgerIdField()),
         # seqNo(str, msgpack keys) -> txn
         ("txns", MapField(NonEmptyStringField(), AnyField())),
-        ("consProof", IterableField(NonEmptyStringField())),
+        # seqNo(str) -> [b58 sibling hashes], leaf->root at size catchupTill
+        ("auditPaths", MapField(NonEmptyStringField(),
+                                IterableField(NonEmptyStringField()))),
+        ("catchupTill", NonNegativeNumberField()),
     )
 
 

@@ -46,6 +46,11 @@ class CompactMerkleTree:
 
     # --- append -----------------------------------------------------------
 
+    def reset(self) -> None:
+        """Forget all leaves (caller resets the hash store; catchup resync)."""
+        self._size = 0
+        self._frontier = []
+
     @property
     def tree_size(self) -> int:
         return self._size
@@ -139,10 +144,11 @@ class CompactMerkleTree:
             return []
 
         def subproof(m: int, lo: int, hi: int, b: bool) -> List[bytes]:
-            if m == hi - lo and b:
-                return []
-            if hi - lo == 1:
-                return [self.merkle_tree_hash(lo, hi)]
+            if m == hi - lo:
+                # SUBPROOF(m, D[m], b): empty if D[0:m] is the known old
+                # tree itself (b), else the one subtree hash — for ANY
+                # width, not just leaves (RFC 6962 §2.1.2)
+                return [] if b else [self.merkle_tree_hash(lo, hi)]
             k = _largest_power_of_two_smaller_than(hi - lo)
             if m <= k:
                 return (subproof(m, lo, lo + k, b)

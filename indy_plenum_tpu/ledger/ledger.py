@@ -127,6 +127,26 @@ class Ledger:
         self.tree.append(data)
         return txn
 
+    def reset_to(self, size: int) -> None:
+        """Truncate the committed log to ``size`` txns (diverged-node
+        resync: everything past — or, for ``size=0``, the whole log — is
+        re-fetched through catchup). The compact tree has no un-append, so
+        the frontier is rebuilt by replaying the surviving txns; stored
+        txns past ``size`` are deleted."""
+        assert not self._uncommitted, "reset_to() while 3PC txns are staged"
+        if size >= self.seq_no:
+            return
+        keep = [self.get_by_seq_no(s) for s in range(1, size + 1)]
+        for s in range(size + 1, self.seq_no + 1):
+            self.txn_store.remove(self._key(s))
+        if self.tree.hash_store is not None:
+            self.tree.hash_store.reset()
+        self.tree.reset()
+        self.seq_no = 0
+        for txn in keep:
+            self.seq_no += 1
+            self.tree.append(self.serializer.dumps(txn))
+
     # --- proofs (serving catchup / state proofs) -------------------------
 
     def audit_path(self, seq_no: int, tree_size: Optional[int] = None):

@@ -1,0 +1,279 @@
+"""Fetching and verifying txn ranges toward an agreed catchup target.
+
+Reference: plenum/server/catchup/catchup_rep_service.py
+(`CatchupRepService`). The range (own_size, target_size] is sliced into
+``CatchupBatchSize`` chunks assigned round-robin over the connected peers;
+each ``CATCHUP_REP`` is verified and applied IN ORDER (out-of-order reps
+are buffered); unanswered or bad slices are re-assigned to the next peer on
+a timer.
+
+TPU-first verification: every txn in a rep carries its audit path against
+the quorum-agreed target root, so the whole slice is checked by ONE call
+into the batched device kernel
+(:func:`indy_plenum_tpu.tpu.sha256.verify_audit_paths`) — leaf hashes,
+indices and padded sibling stacks are assembled host-side, verdicts come
+back as a bool vector. This is BASELINE config 5's hot loop (audit-path
+batch verify at 1M txns). A scalar host fallback (MerkleVerifier) remains
+for tiny slices where the device round-trip outweighs the math.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...common.event_bus import ExternalBus
+from ...common.messages.node_messages import CatchupRep, CatchupReq
+from ...common.timer import RepeatingTimer, TimerService
+from ...ledger.merkle_verifier import STH, MerkleVerifier
+from ...utils.base58 import b58decode
+from ..suspicion_codes import Suspicions
+
+logger = logging.getLogger(__name__)
+
+# below this many proofs the host scalar loop beats the device dispatch
+DEVICE_MIN_BATCH = 32
+# static audit-path depth the kernel is compiled for (2^48 txns); padded
+_MAX_DEPTH = 48
+_BUCKETS = (64, 256, 1024, 4096, 16384)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
+
+
+def verify_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
+                             paths: List[List[bytes]], tree_size: int,
+                             root: bytes) -> np.ndarray:
+    """Verify many RFC 6962 audit paths at once; returns (B,) bool.
+
+    Host-side assembly + one jitted device call (bucketed padding keeps
+    the compile cache small). Falls back to the scalar host verifier for
+    tiny batches.
+    """
+    n = len(leaf_data)
+    if n == 0:
+        return np.zeros(0, bool)
+    if n < DEVICE_MIN_BATCH:
+        v = MerkleVerifier()
+        sth = STH(tree_size=tree_size, sha256_root_hash=root)
+        return np.array([
+            v.verify_leaf_inclusion(d, i, p, sth)
+            for d, i, p in zip(leaf_data, indices, paths)], bool)
+
+    from ...ledger.tree_hasher import TreeHasher
+    from ...tpu.sha256 import verify_audit_paths
+
+    hasher = TreeHasher()
+    if any(len(p) > _MAX_DEPTH for p in paths):
+        return np.zeros(n, bool)
+    size = _bucket(n)
+    leaf = np.zeros((size, 32), np.uint8)
+    idx = np.zeros(size, np.int32)
+    path_arr = np.zeros((size, _MAX_DEPTH, 32), np.uint8)
+    path_len = np.zeros(size, np.int32)
+    for i, (d, ix, p) in enumerate(zip(leaf_data, indices, paths)):
+        leaf[i] = np.frombuffer(hasher.hash_leaf(d), np.uint8)
+        idx[i] = ix
+        for j, node in enumerate(p):
+            path_arr[i, j] = np.frombuffer(node, np.uint8)
+        path_len[i] = len(p)
+    ts = np.full(size, tree_size, np.int32)
+    root_arr = np.broadcast_to(
+        np.frombuffer(root, np.uint8), (size, 32))
+    ok = np.asarray(verify_audit_paths(
+        leaf, idx, path_arr, path_len, ts, np.ascontiguousarray(root_arr)))
+    return ok[:n]
+
+
+class CatchupRepService:
+    def __init__(self,
+                 ledger_id: int,
+                 network: ExternalBus,
+                 timer: TimerService,
+                 db,
+                 config=None,
+                 suspicion_sink=None,
+                 apply_txn: Optional[Callable[[dict], None]] = None):
+        from ...config import getConfig
+
+        self._ledger_id = ledger_id
+        self._network = network
+        self._timer = timer
+        self._db = db
+        self._config = config or getConfig()
+        self._suspicion = suspicion_sink or (lambda ex: None)
+        # called per applied txn (state updates on stateful ledgers)
+        self._apply_txn = apply_txn
+
+        self._running = False
+        self._on_done: Optional[Callable[[], None]] = None
+        self._target_size = 0
+        self._target_root = b""
+        # slice start -> (end, assigned peer)
+        self._outstanding: Dict[int, Tuple[int, str]] = {}
+        # verified-but-early reps: start seq -> ordered txns
+        self._ready: Dict[int, List[dict]] = {}
+        self._peer_rr: List[str] = []
+        self._retry = RepeatingTimer(
+            timer, self._config.CatchupTransactionsTimeout,
+            self._rerequest_outstanding, active=False)
+
+        network.subscribe(CatchupRep, self.process_catchup_rep)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def _ledger(self):
+        return self._db.get_ledger(self._ledger_id)
+
+    def start(self, target_size: int, target_root: bytes,
+              on_done: Callable[[], None]) -> None:
+        ledger = self._ledger
+        self._target_size = target_size
+        self._target_root = target_root
+        self._on_done = on_done
+        self._outstanding.clear()
+        self._ready.clear()
+        self._running = True
+        if ledger.size >= target_size:
+            self._finish()
+            return
+        self._peer_rr = sorted(self._network.connecteds)
+        if not self._peer_rr:
+            logger.warning("catchup ledger %d: no peers connected",
+                           self._ledger_id)
+        self._send_requests(ledger.size + 1, target_size)
+        self._retry.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._retry.stop()
+
+    def _send_requests(self, frm: int, to: int) -> None:
+        if not self._peer_rr:
+            return
+        batch = self._config.CatchupBatchSize
+        i = 0
+        for start in range(frm, to + 1, batch):
+            end = min(start + batch - 1, to)
+            peer = self._peer_rr[i % len(self._peer_rr)]
+            i += 1
+            self._outstanding[start] = (end, peer)
+            self._network.send(CatchupReq(
+                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
+                catchupTill=self._target_size), [peer])
+
+    def _rerequest_outstanding(self) -> None:
+        """Reassign every still-unanswered slice to the next peer."""
+        if not self._running or not self._outstanding:
+            return
+        self._peer_rr = sorted(self._network.connecteds)
+        if not self._peer_rr:
+            return
+        for start, (end, old_peer) in list(self._outstanding.items()):
+            others = [p for p in self._peer_rr if p != old_peer] \
+                or self._peer_rr
+            peer = others[start % len(others)]
+            self._outstanding[start] = (end, peer)
+            self._network.send(CatchupReq(
+                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
+                catchupTill=self._target_size), [peer])
+            logger.info("catchup ledger %d: re-requesting %d..%d from %s",
+                        self._ledger_id, start, end, peer)
+
+    # ------------------------------------------------------------------
+
+    def process_catchup_rep(self, rep: CatchupRep, sender: str):
+        if not self._running or rep.ledgerId != self._ledger_id:
+            return
+        if rep.catchupTill != self._target_size:
+            return
+        try:
+            seqs = sorted(int(s) for s in dict(rep.txns))
+        except (TypeError, ValueError):
+            return
+        if not seqs:
+            return
+        start = seqs[0]
+        expected = self._outstanding.get(start)
+        if expected is None or expected[1] != sender:
+            return  # unsolicited (or already satisfied)
+        end = expected[0]
+        if seqs != list(range(start, min(end, seqs[-1]) + 1)):
+            return  # holes — treat like silence; the retry timer reassigns
+
+        txns = dict(rep.txns)
+        paths_raw = dict(rep.auditPaths or {})
+        ledger = self._ledger
+        leaf_data, indices, paths = [], [], []
+        try:
+            for s in seqs:
+                leaf_data.append(ledger.serializer.dumps(txns[str(s)]))
+                indices.append(s - 1)
+                paths.append([b58decode(h) for h in paths_raw[str(s)]])
+        except (KeyError, ValueError):
+            self._bad_rep(sender, start)
+            return
+
+        ok = verify_audit_paths_batch(
+            leaf_data, indices, paths, self._target_size, self._target_root)
+        if not ok.all():
+            logger.warning(
+                "catchup ledger %d: %d/%d txns from %s FAIL audit proof",
+                self._ledger_id, int((~ok).sum()), len(ok), sender)
+            self._bad_rep(sender, start)
+            return
+
+        del self._outstanding[start]
+        self._ready[start] = [txns[str(s)] for s in seqs]
+        if seqs[-1] < end:
+            # short (clamped) rep: re-request the tail
+            peer = self._peer_rr[seqs[-1] % len(self._peer_rr)] \
+                if self._peer_rr else sender
+            self._outstanding[seqs[-1] + 1] = (end, peer)
+            self._network.send(CatchupReq(
+                ledgerId=self._ledger_id, seqNoStart=seqs[-1] + 1,
+                seqNoEnd=end, catchupTill=self._target_size), [peer])
+        self._apply_ready()
+
+    def _bad_rep(self, sender: str, start: int) -> None:
+        from ...common.exceptions import SuspiciousNode
+
+        self._suspicion(SuspiciousNode(sender, Suspicions.CATCHUP_REP_WRONG))
+        # reassign this slice to someone else immediately
+        end, _ = self._outstanding[start]
+        others = [p for p in self._peer_rr if p != sender] or self._peer_rr
+        if others:
+            peer = others[start % len(others)]
+            self._outstanding[start] = (end, peer)
+            self._network.send(CatchupReq(
+                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
+                catchupTill=self._target_size), [peer])
+
+    def _apply_ready(self) -> None:
+        ledger = self._ledger
+        while True:
+            nxt = ledger.size + 1
+            txns = self._ready.pop(nxt, None)
+            if txns is None:
+                break
+            for txn in txns:
+                ledger.add(txn)
+                if self._apply_txn is not None:
+                    self._apply_txn(txn)
+        if ledger.size >= self._target_size:
+            self._finish()
+
+    def _finish(self) -> None:
+        self.stop()
+        cb = self._on_done
+        self._on_done = None
+        logger.info("catchup ledger %d complete at size %d", self._ledger_id,
+                    self._ledger.size)
+        if cb is not None:
+            cb()

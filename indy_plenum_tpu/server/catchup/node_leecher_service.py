@@ -1,0 +1,241 @@
+"""Whole-node catchup orchestration: AUDIT first, then the rest.
+
+Reference: plenum/server/catchup/node_leecher_service.py
+(`NodeLeecherService`) + ledger_leecher_service.py (merged: one ledger's
+pipeline is just ConsProof -> CatchupRep here). Sequencing (reference
+order): the AUDIT ledger is synced first via a peer quorum
+(ConsProofService), because its last txn — the recovery spine written by
+AuditBatchHandler per 3PC batch — pins the exact (size, root) every other
+ledger must reach, plus the (viewNo, ppSeqNo, primaries) the consensus
+layer must resume from. The other ledgers then sync against those pinned
+targets with no further quorum rounds.
+
+Divergence recovery: if the cons-proof phase convicts our own history
+(f+1 peers' trees disagree with ours at our size), or a ledger's
+post-fetch root mismatches its audit-pinned target, the ledger is
+truncated (``Ledger.reset_to(0)``) and re-fetched from scratch — states
+are derived data and rebuilt from the ledgers afterwards.
+
+Consumes ``NeedMasterCatchup`` (checkpoint lag / checkpoint digest
+divergence — both emit sites in checkpoint_service.py); emits
+``CatchupFinished`` for the consensus services to resync their 3PC state.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from ...common.constants import (
+    AUDIT_LEDGER_ID,
+    AUDIT_TXN_LEDGER_ROOT,
+    AUDIT_TXN_LEDGERS_SIZE,
+    AUDIT_TXN_PP_SEQ_NO,
+    AUDIT_TXN_PRIMARIES,
+    AUDIT_TXN_VIEW_NO,
+    CONFIG_LEDGER_ID,
+    DOMAIN_LEDGER_ID,
+    POOL_LEDGER_ID,
+)
+from ...common.event_bus import ExternalBus, InternalBus
+from ...common.messages.internal_messages import (
+    CatchupFinished,
+    NeedMasterCatchup,
+)
+from ...common.timer import TimerService
+from ...common.txn_util import get_payload_data
+from ...utils.base58 import b58decode, b58encode
+from .catchup_rep_service import CatchupRepService
+from .cons_proof_service import ConsProofService
+
+logger = logging.getLogger(__name__)
+
+# catchup order after AUDIT (reference: audit pins the others' targets)
+LEDGER_ORDER = (POOL_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID)
+
+
+class NodeLeecherService:
+    def __init__(self,
+                 data,
+                 bus: InternalBus,
+                 network: ExternalBus,
+                 timer: TimerService,
+                 bootstrap,
+                 config=None,
+                 suspicion_sink=None):
+        """``bootstrap`` is the node's LedgersBootstrap (ledgers, states,
+        write manager, state-rebuild)."""
+        from ...config import getConfig
+
+        self._data = data
+        self._bus = bus
+        self._network = network
+        self._timer = timer
+        self._boot = bootstrap
+        self._config = config or getConfig()
+        self._suspicion = suspicion_sink or (lambda ex: None)
+
+        self._running = False
+        self._audit_attempts = 0
+        self._remaining: List[int] = []
+        self.catchups_completed = 0  # observability / tests
+
+        self._cons_proof = ConsProofService(
+            AUDIT_LEDGER_ID, network, timer, self._boot.db,
+            quorums_provider=lambda: self._data.quorums,
+            config=self._config)
+        self._rep_services = {
+            lid: CatchupRepService(
+                lid, network, timer, self._boot.db, config=self._config,
+                suspicion_sink=self._suspicion)
+            for lid in (AUDIT_LEDGER_ID,) + LEDGER_ORDER}
+
+        bus.subscribe(NeedMasterCatchup, self._on_need_catchup)
+
+    # ------------------------------------------------------------------
+
+    def _on_need_catchup(self, msg: NeedMasterCatchup, *args) -> None:
+        self.start()
+
+    def start(self) -> None:
+        """Idempotent: a second trigger while catching up is a no-op."""
+        if self._running:
+            return
+        self._running = True
+        logger.info("%s starting catchup", self._data.name)
+        self._data.is_participating = False
+        # uncommitted 3PC work is void — catchup writes committed txns and
+        # Ledger.add() requires nothing staged
+        self._revert_all_staged()
+        self._audit_attempts = 0
+        self._start_audit_phase()
+
+    def _revert_all_staged(self) -> None:
+        wm = self._boot.write_manager
+        for staged in reversed(wm.staged_batches):
+            wm.revert_batches(staged.ledger_id, 1)
+
+    # ------------------------------------------------------------------
+    # phase 1: AUDIT ledger via peer quorum
+    # ------------------------------------------------------------------
+
+    def _start_audit_phase(self) -> None:
+        self._cons_proof.start(self._on_audit_target)
+
+    def _on_audit_target(self, target, diverged: bool) -> None:
+        audit = self._boot.db.get_ledger(AUDIT_LEDGER_ID)
+        if diverged:
+            logger.warning("%s: audit ledger diverged; resyncing from "
+                           "scratch", self._data.name)
+            audit.reset_to(0)
+            self._restart_audit_phase()
+            return
+        size, root_b58 = target
+        self._audit_target = (size, b58decode(root_b58))
+        self._rep_services[AUDIT_LEDGER_ID].start(
+            size, self._audit_target[1], self._on_audit_fetched)
+
+    def _restart_audit_phase(self) -> None:
+        self._audit_attempts += 1
+        if self._audit_attempts > 3:
+            logger.error("%s: audit catchup failed %d times; giving up "
+                         "this round", self._data.name, self._audit_attempts)
+            self._finish(failed=True)
+            return
+        self._start_audit_phase()
+
+    def _on_audit_fetched(self) -> None:
+        audit = self._boot.db.get_ledger(AUDIT_LEDGER_ID)
+        size, root = self._audit_target
+        if audit.size >= size and audit.root_hash != root:
+            # our pre-existing prefix was wrong (behind AND diverged)
+            logger.warning("%s: audit root mismatch after fetch; resync",
+                           self._data.name)
+            audit.reset_to(0)
+            self._restart_audit_phase()
+            return
+        self._remaining = list(LEDGER_ORDER)
+        self._next_ledger()
+
+    # ------------------------------------------------------------------
+    # phase 2: remaining ledgers against audit-pinned targets
+    # ------------------------------------------------------------------
+
+    def _audit_pinned_target(self, lid: int):
+        audit = self._boot.db.get_ledger(AUDIT_LEDGER_ID)
+        if audit.size == 0:
+            return None
+        data = get_payload_data(audit.get_by_seq_no(audit.size))
+        size = data.get(AUDIT_TXN_LEDGERS_SIZE, {}).get(str(lid))
+        root = data.get(AUDIT_TXN_LEDGER_ROOT, {}).get(str(lid))
+        if size is None or root is None:
+            return None
+        # ledgerRoot may be recorded as a delta reference (int = audit seq
+        # of the batch that last changed it) in the reference; here it is
+        # always the b58 root string
+        return int(size), b58decode(root)
+
+    def _next_ledger(self) -> None:
+        while self._remaining:
+            lid = self._remaining.pop(0)
+            target = self._audit_pinned_target(lid)
+            ledger = self._boot.db.get_ledger(lid)
+            if target is None:
+                continue  # ledger never touched by a batch: genesis only
+            size, root = target
+            if ledger.size > size or (
+                    ledger.size == size and ledger.root_hash != root):
+                logger.warning("%s: ledger %d diverged from audit target; "
+                               "resyncing from scratch",
+                               self._data.name, lid)
+                ledger.reset_to(0)
+            if ledger.size == size:
+                continue
+            self._current_lid = lid
+            self._current_target = (size, root)
+            self._rep_services[lid].start(size, root, self._on_ledger_fetched)
+            return
+        self._finish()
+
+    def _on_ledger_fetched(self) -> None:
+        lid = self._current_lid
+        size, root = self._current_target
+        ledger = self._boot.db.get_ledger(lid)
+        if ledger.size >= size and ledger.root_hash != root:
+            logger.warning("%s: ledger %d root mismatch after fetch; "
+                           "resyncing from scratch", self._data.name, lid)
+            ledger.reset_to(0)
+            self._rep_services[lid].start(size, root, self._on_ledger_fetched)
+            return
+        self._next_ledger()
+
+    # ------------------------------------------------------------------
+    # phase 3: states + consensus resync
+    # ------------------------------------------------------------------
+
+    def _finish(self, failed: bool = False) -> None:
+        self._running = False
+        if failed:
+            self._data.is_participating = True
+            return
+        # states are derived: replay fetched txns through the handlers
+        # (coverage located via the audit spine)
+        self._boot._rebuild_states_if_behind()
+
+        audit = self._boot.db.get_ledger(AUDIT_LEDGER_ID)
+        view_no, pp_seq_no = self._data.view_no, self._data.last_ordered_3pc[1]
+        if audit.size > 0:
+            data = get_payload_data(audit.get_by_seq_no(audit.size))
+            view_no = data.get(AUDIT_TXN_VIEW_NO, view_no)
+            pp_seq_no = data.get(AUDIT_TXN_PP_SEQ_NO, pp_seq_no)
+            primaries = data.get(AUDIT_TXN_PRIMARIES)
+            if primaries:
+                self._data.primaries = list(primaries)
+        if view_no > self._data.view_no:
+            self._data.view_no = view_no
+        self._data.is_participating = True
+        self.catchups_completed += 1
+        logger.info("%s catchup complete: 3pc=(%d,%d)", self._data.name,
+                    view_no, pp_seq_no)
+        self._bus.send(CatchupFinished(
+            last_caught_up_3pc=(view_no, pp_seq_no),
+            master_last_ordered=(view_no, pp_seq_no)))

@@ -1,0 +1,114 @@
+"""Answering peers' catchup requests from our committed ledgers.
+
+Reference: plenum/server/catchup/seeder_service.py (`SeederService`).
+Two inbound message types:
+
+- ``LEDGER_STATUS`` from a peer: if the peer is behind us, reply with a
+  ``CONSISTENCY_PROOF`` (their size -> our size, RFC 6962) so its
+  ConsProofService can agree on a catchup target; if it matches us, echo
+  our own ``LEDGER_STATUS`` (an "up to date" vote).
+- ``CATCHUP_REQ`` for a txn range: reply with the txns AND a per-txn audit
+  path against the requested ``catchupTill`` tree size (the TPU-first
+  redesign: the leecher verifies the whole slice in one vmapped device
+  kernel call instead of an incremental host tree fold).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ...common.event_bus import ExternalBus
+from ...common.messages.node_messages import (
+    CatchupRep,
+    CatchupReq,
+    ConsistencyProof,
+    LedgerStatus,
+)
+from ...server.database_manager import DatabaseManager
+from ...utils.base58 import b58encode
+
+logger = logging.getLogger(__name__)
+
+# cap on txns per CATCHUP_REP (the requester also slices; defence in depth)
+MAX_TXNS_PER_REP = 10_000
+
+
+class SeederService:
+    def __init__(self, network: ExternalBus, db: DatabaseManager,
+                 own_name: str = "?"):
+        self._network = network
+        self._db = db
+        self._name = own_name
+        network.subscribe(LedgerStatus, self.process_ledger_status)
+        network.subscribe(CatchupReq, self.process_catchup_req)
+
+    def _ledger(self, ledger_id: int):
+        try:
+            return self._db.get_ledger(ledger_id)
+        except KeyError:
+            return None
+
+    # ------------------------------------------------------------------
+
+    def own_ledger_status(self, ledger_id: int) -> Optional[LedgerStatus]:
+        ledger = self._ledger(ledger_id)
+        if ledger is None:
+            return None
+        return LedgerStatus(
+            ledgerId=ledger_id,
+            txnSeqNo=ledger.size,
+            viewNo=None,
+            ppSeqNo=None,
+            merkleRoot=b58encode(ledger.root_hash),
+            protocolVersion=2,
+        )
+
+    def process_ledger_status(self, status: LedgerStatus, sender: str):
+        ledger = self._ledger(status.ledgerId)
+        if ledger is None:
+            return
+        their_size = status.txnSeqNo
+        if their_size > ledger.size:
+            return  # we are the laggard; our own leecher handles that
+        if their_size == ledger.size:
+            # equality vote (also lets a diverged same-size peer notice the
+            # root mismatch in our status)
+            self._network.send(self.own_ledger_status(status.ledgerId),
+                               [sender])
+            return
+        proof = ConsistencyProof(
+            ledgerId=status.ledgerId,
+            seqNoStart=their_size,
+            seqNoEnd=ledger.size,
+            viewNo=None,
+            ppSeqNo=None,
+            oldMerkleRoot=b58encode(ledger.root_hash_at(their_size))
+            if their_size > 0 else b58encode(b"\x00" * 32),
+            newMerkleRoot=b58encode(ledger.root_hash),
+            hashes=[b58encode(h)
+                    for h in ledger.consistency_proof(their_size)],
+        )
+        self._network.send(proof, [sender])
+
+    # ------------------------------------------------------------------
+
+    def process_catchup_req(self, req: CatchupReq, sender: str):
+        ledger = self._ledger(req.ledgerId)
+        if ledger is None:
+            return
+        till = min(req.catchupTill, ledger.size)
+        start = max(1, req.seqNoStart)
+        end = min(req.seqNoEnd, till, start + MAX_TXNS_PER_REP - 1)
+        if start > end or till <= 0:
+            return  # nothing we can serve
+        txns = {}
+        paths = {}
+        for seq in range(start, end + 1):
+            txns[str(seq)] = ledger.get_by_seq_no(seq)
+            paths[str(seq)] = [
+                b58encode(h) for h in ledger.audit_path(seq, till)]
+        rep = CatchupRep(ledgerId=req.ledgerId, txns=txns,
+                         auditPaths=paths, catchupTill=till)
+        self._network.send(rep, [sender])
+        logger.debug("%s seeded %d..%d of ledger %d to %s", self._name,
+                     start, end, req.ledgerId, sender)

@@ -16,6 +16,7 @@ from typing import Dict, Optional, Tuple
 
 from ...common.event_bus import ExternalBus, InternalBus
 from ...common.messages.internal_messages import (
+    CatchupFinished,
     CheckpointStabilized,
     NeedMasterCatchup,
     ViewChangeStarted,
@@ -65,6 +66,7 @@ class CheckpointService:
         stasher.subscribe(Checkpoint, self.process_checkpoint)
         bus.subscribe(Ordered, self.process_ordered)
         bus.subscribe(ViewChangeStarted, self.process_view_change_started)
+        bus.subscribe(CatchupFinished, self.process_catchup_finished)
 
     @property
     def _chk_freq(self) -> int:
@@ -196,6 +198,17 @@ class CheckpointService:
         # checkpoints from the old view are void (digest chain broken),
         # except the stable one which is carried by the VIEW_CHANGE msgs
         self._digests_since.clear()
+
+    def process_catchup_finished(self, msg: CatchupFinished) -> None:
+        """Catchup moved the stable floor (set by the leecher on shared
+        data); the digest chain below it is void, votes at/below it are
+        stale."""
+        _, pp_seq_no = msg.last_caught_up_3pc
+        self._digests_since.clear()
+        self._own_checkpoints = {
+            s: c for s, c in self._own_checkpoints.items() if s > pp_seq_no}
+        self._received = {
+            k: v for k, v in self._received.items() if k[1] > pp_seq_no}
 
     # --- introspection -------------------------------------------------
 

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ...common.event_bus import ExternalBus, InternalBus
 from ...common.exceptions import SuspiciousNode
 from ...common.messages.internal_messages import (
+    CatchupFinished,
     CheckpointStabilized,
     MissingMessage,
     NewViewCheckpointsApplied,
@@ -197,6 +198,7 @@ class OrderingService:
         bus.subscribe(NewViewCheckpointsApplied,
                       self.process_new_view_checkpoints_applied)
         bus.subscribe(CheckpointStabilized, self.process_checkpoint_stabilized)
+        bus.subscribe(CatchupFinished, self.process_catchup_finished)
 
         self._batch_timer = RepeatingTimer(
             timer, self._config.Max3PCBatchWait, self._on_batch_timer,
@@ -713,6 +715,31 @@ class OrderingService:
             return  # no longer waiting (another view change happened)
         self._apply_new_view_batch(pp, new_view_no, orig)
         self._stasher.process_stashed(STASH_WAITING_PREV_PP)
+
+    def process_catchup_finished(self, msg: CatchupFinished) -> None:
+        """Resync 3PC state to the durably caught-up point: everything at
+        or below it is already executed (the ledgers ARE the certificates);
+        stashed messages for the live tail replay through the normal path."""
+        view_no, pp_seq_no = msg.last_caught_up_3pc
+        if pp_seq_no > self._data.last_ordered_3pc[1]:
+            self._data.last_ordered_3pc = (view_no, pp_seq_no)
+        self._data.pp_seq_no = max(self._data.pp_seq_no, pp_seq_no)
+        self._data.low_watermark = max(self._data.low_watermark, pp_seq_no)
+        self._data.stable_checkpoint = max(self._data.stable_checkpoint,
+                                           pp_seq_no)
+        self._data.free_upto(pp_seq_no)
+        if self._executor is not None:
+            self._last_applied_seq = max(self._last_applied_seq,
+                                         self._executor.committed_seq())
+        for store in (self.sent_preprepares, self.prePrepares,
+                      self.prepares, self.commits, self.batches):
+            for key in [k for k in store if k[1] <= pp_seq_no]:
+                del store[key]
+        self.ordered = {k for k in self.ordered if k[1] > pp_seq_no}
+        if self._vote_plane is not None:
+            self._vote_plane.reset(h=pp_seq_no)
+        self._bls.gc((view_no, pp_seq_no))
+        self._stasher.process_all_stashed()
 
     def process_checkpoint_stabilized(self, msg: CheckpointStabilized) -> None:
         """GC 3PC logs at or below the new stable checkpoint."""

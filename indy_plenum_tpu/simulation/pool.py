@@ -236,11 +236,36 @@ class SimNode:
             network=self.external_bus, ordering_service=self.ordering,
             view_change_service=self.view_changer)
 
+        # catchup plane (requires real ledgers): every node seeds; the
+        # leecher consumes NeedMasterCatchup from the checkpoint service
+        self.seeder = None
+        self.leecher = None
+        if self.boot is not None:
+            from ..server.catchup import NodeLeecherService, SeederService
+
+            self.seeder = SeederService(
+                self.external_bus, self.boot.db, own_name=name)
+
+            def catchup_suspicion(ex):
+                from ..common.messages.internal_messages import (
+                    RaisedSuspicion,
+                )
+
+                self.internal_bus.send(RaisedSuspicion(inst_id=0, ex=ex))
+
+            self.leecher = NodeLeecherService(
+                data=self.data, bus=self.internal_bus,
+                network=self.external_bus, timer=timer, bootstrap=self.boot,
+                config=config, suspicion_sink=catchup_suspicion)
+
         # execution: commit batches as they order (the Node's job);
         # re-ordered duplicates after a view change are skipped by seqNo
         self.ordered_log: List[Ordered] = []
         self.executed_upto = 0
         self.internal_bus.subscribe(Ordered, self._on_ordered)
+        from ..common.messages.internal_messages import CatchupFinished
+
+        self.internal_bus.subscribe(CatchupFinished, self._on_catchup_finished)
         self.ordering.start()
 
     def _on_ordered(self, ordered: Ordered, *args) -> None:
@@ -250,6 +275,12 @@ class SimNode:
         self.executed_upto = ordered.ppSeqNo
         self.ordered_log.append(ordered)
         self.executor.commit_batch(ordered.ppSeqNo)
+
+    def _on_catchup_finished(self, msg, *args) -> None:
+        # batches at/below the caught-up point were executed THROUGH the
+        # ledger fetch, not through Ordered
+        self.executed_upto = max(self.executed_upto,
+                                 msg.last_caught_up_3pc[1])
 
     def read_nym_with_proof(self, did: str):
         """Proved read from THIS node alone (requires real_execution+bls):

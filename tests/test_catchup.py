@@ -1,0 +1,245 @@
+"""Catchup: lagging-node rejoin, diverged-node resync, batched proofs.
+
+Reference capabilities: plenum/server/catchup/ (NodeLeecherService,
+ConsProofService, CatchupRepService, SeederService) and the
+plenum/test/node_catchup/ suites. Verification of fetched txn ranges is
+the device audit-path kernel (tpu/sha256.verify_audit_paths) — the same
+code path BASELINE config 5 benches.
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID,
+    DOMAIN_LEDGER_ID,
+)
+from indy_plenum_tpu.ledger.ledger import Ledger
+from indy_plenum_tpu.ledger.merkle_verifier import STH, MerkleVerifier
+from indy_plenum_tpu.server.catchup import verify_audit_paths_batch
+from indy_plenum_tpu.simulation.pool import SimPool
+
+CATCHUP_CONFIG = {
+    "Max3PCBatchWait": 0.1,
+    "Max3PCBatchSize": 1,  # one batch per request: checkpoints move per txn
+    # small windows so the checkpoint-lag trigger actually fires in-sim
+    "CHK_FREQ": 2,
+    "LOG_SIZE": 4,
+    # snappy retries under the mock clock
+    "ConsistencyProofsTimeout": 1.0,
+    "CatchupTransactionsTimeout": 1.5,
+}
+
+
+def make_pool(n=4, seed=0):
+    from indy_plenum_tpu.config import getConfig
+
+    return SimPool(n, seed=seed, real_execution=True,
+                   config=getConfig(dict(CATCHUP_CONFIG)))
+
+
+def domain_sizes(pool):
+    return [n.boot.db.get_ledger(DOMAIN_LEDGER_ID).size for n in pool.nodes]
+
+
+def domain_roots(pool):
+    return [n.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+            for n in pool.nodes]
+
+
+# ---------------------------------------------------------------------------
+# tier 1: the batched proof verifier against the scalar oracle
+# ---------------------------------------------------------------------------
+
+
+def test_batched_audit_path_verify_matches_host():
+    ledger = Ledger()
+    for i in range(300):
+        ledger.add({"k": i, "blob": hashlib.sha256(bytes([i % 251])).hexdigest()})
+    size = ledger.size
+    root = ledger.root_hash
+
+    leaf_data, indices, paths = [], [], []
+    for seq in range(1, size + 1):
+        leaf_data.append(ledger.serializer.dumps(ledger.get_by_seq_no(seq)))
+        indices.append(seq - 1)
+        paths.append(ledger.audit_path(seq, size))
+    ok = verify_audit_paths_batch(leaf_data, indices, paths, size, root)
+    assert ok.all()
+
+    # corrupt one leaf, one path, one index
+    leaf_data[7] = leaf_data[7] + b"x"
+    paths[13] = [paths[13][0][::-1]] + list(paths[13][1:])
+    indices[21] = 22
+    ok = verify_audit_paths_batch(leaf_data, indices, paths, size, root)
+    bad = {7, 13, 21}
+    assert [bool(v) for v in ok] == [i not in bad for i in range(size)]
+
+    # host oracle agrees everywhere (device kernel == MerkleVerifier)
+    v = MerkleVerifier()
+    sth = STH(tree_size=size, sha256_root_hash=root)
+    for i in range(size):
+        assert v.verify_leaf_inclusion(leaf_data[i], indices[i], paths[i],
+                                       sth) == bool(ok[i])
+
+
+def test_batched_audit_path_verify_small_batch_host_path():
+    ledger = Ledger()
+    for i in range(5):
+        ledger.add({"k": i})
+    data = [ledger.serializer.dumps(ledger.get_by_seq_no(s))
+            for s in range(1, 6)]
+    paths = [ledger.audit_path(s, 5) for s in range(1, 6)]
+    ok = verify_audit_paths_batch(data, list(range(5)), paths, 5,
+                                  ledger.root_hash)
+    assert ok.all() and len(ok) == 5
+
+
+# ---------------------------------------------------------------------------
+# tier 5: sim pool scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_lagging_node_catches_up_and_rejoins():
+    """A node disconnected past a stable checkpoint rejoins, syncs the
+    missed txns through catchup (triggered by the checkpoint-lag path),
+    and orders the live tail with the pool again."""
+    pool = make_pool(seed=21)
+    for i in range(2):
+        pool.submit_request(i)
+    pool.run_for(5)
+    assert min(domain_sizes(pool)) == max(domain_sizes(pool))
+
+    pool.network.disconnect("node3")
+    n_missed = 8
+    for i in range(2, 2 + n_missed):
+        pool.submit_request(i)
+    pool.run_for(10)
+    behind = pool.node("node3")
+    assert behind.boot.db.get_ledger(DOMAIN_LEDGER_ID).size \
+        < pool.node("node0").boot.db.get_ledger(DOMAIN_LEDGER_ID).size
+
+    pool.network.reconnect("node3")
+    # peers' checkpoints beyond node3's H trigger NeedMasterCatchup; give
+    # the pool some live traffic so fresh checkpoints actually arrive
+    for i in range(100, 104):
+        pool.submit_request(i)
+    pool.run_for(20)
+
+    assert behind.leecher.catchups_completed >= 1
+    sizes = domain_sizes(pool)
+    roots = domain_roots(pool)
+    assert len(set(sizes)) == 1, sizes
+    assert len(set(roots)) == 1
+    # and the node is live again: it participates in NEW ordering
+    pre = behind.boot.db.get_ledger(DOMAIN_LEDGER_ID).size
+    for i in range(200, 203):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert behind.boot.db.get_ledger(DOMAIN_LEDGER_ID).size == pre + 3
+    assert len(set(domain_roots(pool))) == 1
+
+
+def test_restarted_node_syncs_via_explicit_catchup():
+    """Direct leecher start (the boot-time path: Node.start_catchup)."""
+    pool = make_pool(seed=22)
+    for i in range(6):
+        pool.submit_request(i)
+    pool.run_for(8)
+
+    pool.network.disconnect("node2")
+    for i in range(6, 12):
+        pool.submit_request(i)
+    pool.run_for(10)
+
+    pool.network.reconnect("node2")
+    pool.node("node2").leecher.start()
+    pool.run_for(10)
+
+    assert len(set(domain_sizes(pool))) == 1
+    assert len(set(domain_roots(pool))) == 1
+    audit_sizes = [n.boot.db.get_ledger(AUDIT_LEDGER_ID).size
+                   for n in pool.nodes]
+    assert len(set(audit_sizes)) == 1
+
+
+def test_diverged_node_detects_and_resyncs():
+    """A node whose ledgers hold a WRONG history (not merely short) must
+    detect the divergence against the pool and rebuild from scratch."""
+    pool = make_pool(seed=23)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+
+    evil = pool.node("node1")
+    # corrupt: rewrite node1's domain + audit ledgers with a fake tail
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    good_size = domain.size
+    domain.reset_to(max(0, good_size - 2))
+    domain.add({"fake": 1})
+    domain.add({"fake": 2})
+    assert domain.size == good_size  # same length, different history
+    audit.reset_to(max(0, audit.size - 1))
+    audit.add({"fake_audit": 1})
+
+    honest_root = pool.node("node0").boot.db.get_ledger(
+        DOMAIN_LEDGER_ID).root_hash
+    assert domain.root_hash != honest_root
+
+    evil.leecher.start()
+    pool.run_for(15)
+
+    assert evil.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash == honest_root
+    assert evil.boot.db.get_ledger(AUDIT_LEDGER_ID).root_hash == \
+        pool.node("node0").boot.db.get_ledger(AUDIT_LEDGER_ID).root_hash
+    # state was rebuilt to match too
+    assert evil.boot.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash == \
+        pool.node("node0").boot.db.get_state(
+            DOMAIN_LEDGER_ID).committed_head_hash
+
+
+def test_checkpoint_divergence_triggers_recovery():
+    """The checkpoint-digest-divergence dead end from rounds 1-2: a node
+    whose execution diverged detects quorum-on-a-different-digest and now
+    actually RECOVERS (NeedMasterCatchup has a consumer)."""
+    pool = make_pool(seed=24)
+    for i in range(2):
+        pool.submit_request(i)
+    pool.run_for(5)
+
+    evil = pool.node("node2")
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    domain.reset_to(domain.size - 1)
+    domain.add({"fake": 99})
+    audit.reset_to(audit.size - 1)
+    audit.add({"fake_audit": 99})
+
+    evil.leecher.start()
+    pool.run_for(15)
+    assert len(set(domain_roots(pool))) == 1
+    # evil node keeps up with new traffic afterwards
+    for i in range(50, 53):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert len(set(domain_roots(pool))) == 1
+    assert len(set(domain_sizes(pool))) == 1
+
+
+def test_ledger_reset_to():
+    ledger = Ledger()
+    txns = [{"k": i} for i in range(10)]
+    for t in txns:
+        ledger.add(dict(t))
+    root_5 = ledger.root_hash_at(5)
+    ledger.reset_to(5)
+    assert ledger.size == 5
+    assert ledger.root_hash == root_5
+    # appending after reset reproduces the original tree
+    for t in txns[5:]:
+        ledger.add(dict(t))
+    assert ledger.size == 10
+    with pytest.raises(KeyError):
+        Ledger().get_by_seq_no(1)

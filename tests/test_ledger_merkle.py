@@ -48,13 +48,14 @@ def test_audit_paths_verify():
 
 
 def test_consistency_proofs():
+    # EXHAUSTIVE over all (old, new) pairs: the SUBPROOF(m, D[m], false)
+    # case (a complete old-subtree inside the new tree, e.g. old=6 new=20)
+    # regressed once by only being handled at leaf width
     tree = CompactMerkleTree()
     tree.extend(LEAVES)
     verifier = MerkleVerifier()
-    for old in (1, 2, 3, 8, 64, 129):
-        for new in (old, old + 1, 100, 130):
-            if new < old or new > len(LEAVES):
-                continue
+    for old in range(1, 131):
+        for new in range(old, 131):
             proof = tree.consistency_proof(old, new)
             assert verifier.verify_consistency(
                 old, new, tree.root_hash_at(old), tree.root_hash_at(new),

@@ -1,23 +1,52 @@
 """Benchmark driver entry: prints ONE JSON line with the headline metric.
 
-Headline metric (BASELINE.md config 2 / north star): batched Ed25519
-signature verifications per second per chip, measured on the device the
-driver provides (real TPU under axon; CPU otherwise).
-
+Headline (BASELINE.md config 2): batched Ed25519 signature verifies/sec/chip
+on the device the driver provides (real TPU under axon; CPU otherwise).
 Baseline: libsodium Ed25519 verify on one CPU core is ~15-30k ops/sec
-(BASELINE.md provenance note; the reference publishes no numbers). We use
-25k/sec as the reference point for ``vs_baseline``.
+(BASELINE.md provenance note); we use 25k/sec as the reference point.
+
+``extra_metrics`` carries the other BASELINE configs measured this round:
+- ordered txns/sec at n=64 simulated validators (the north star), with the
+  device quorum plane as the SOLE certificate authority (shadow_check off,
+  tick-batched flushes) — BASELINE.json north_star;
+- catchup audit-path proofs verified/sec at 131072 txns (config 5), with
+  vs_baseline measured against the host scalar verifier ON THIS MACHINE.
+
+Every sub-bench runs under a bounded retry (round 2's 72k/s kernel scored 0
+because one transient remote-compile HTTP error escaped), and the JSON line
+is emitted even if sub-benches fail — a failure becomes an ``error`` entry,
+never a missing round record.
 """
 import json
 import sys
 import time
+import traceback
 
 BASELINE_CPU_VERIFIES_PER_SEC = 25_000.0
-BATCH = 32768  # throughput is overhead-bound; large batches are nearly free
+# the reference publishes no numbers (BASELINE.json "published": {});
+# community folklore for indy pools is low-hundreds of write txns/sec at
+# 4-25 nodes with O(n^2) message handling, so 100/sec at n=64 is a
+# deliberately generous CPU reference estimate. Clearly labelled as such.
+ESTIMATED_REFERENCE_ORDERED_TXNS_PER_SEC_N64 = 100.0
+
+ED_BATCH = 32768
 REPS = 3
 
 
-def main() -> None:
+def _retry(fn, attempts=3, delay=2.0):
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as ex:  # noqa: BLE001 — must never lose the round
+            last = ex
+            traceback.print_exc(file=sys.stderr)
+            if i + 1 < attempts:
+                time.sleep(delay)
+    raise last
+
+
+def bench_ed25519() -> dict:
     import numpy as np
 
     from indy_plenum_tpu.crypto import ed25519 as ed
@@ -27,7 +56,7 @@ def main() -> None:
     seeds = [rng.bytes(32) for _ in range(64)]
     pks_all = [ed.fast_public_key(s) for s in seeds]
     pks, msgs, sigs = [], [], []
-    for i in range(BATCH):
+    for i in range(ED_BATCH):
         seed = seeds[i % len(seeds)]
         msg = rng.bytes(64)
         pks.append(pks_all[i % len(seeds)])
@@ -41,30 +70,195 @@ def main() -> None:
     assert pre.all()
     args = [jax.device_put(jnp.asarray(a)) for a in (pk_a, r_a, s_a, h_a)]
 
-    ok = np.asarray(ted.verify_kernel(*args))  # compile + warm
+    ok = np.asarray(_retry(lambda: ted.verify_kernel(*args)))  # compile+warm
     assert ok.all(), "benchmark batch failed verification"
 
     times = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        ted.verify_kernel(*args).block_until_ready()
+        _retry(lambda: ted.verify_kernel(*args).block_until_ready())
         times.append(time.perf_counter() - t0)
     best = min(times)
-    value = BATCH / best
+    value = ED_BATCH / best
+    return {
+        "metric": "ed25519_verifies_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(value / BASELINE_CPU_VERIFIES_PER_SEC, 3),
+        "batch": ED_BATCH,
+        "best_ms": round(best * 1e3, 2),
+        "device": str(jax.devices()[0]),
+    }
 
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_verifies_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "verifies/sec",
-                "vs_baseline": round(value / BASELINE_CPU_VERIFIES_PER_SEC, 3),
-                "batch": BATCH,
-                "best_ms": round(best * 1e3, 2),
-                "device": str(jax.devices()[0]),
-            }
-        )
+
+def bench_ordered_txns_n64() -> dict:
+    """North star: ordered txns/sec, 64 simulated validators, device quorum
+    plane as sole authority (no host shadow tallies), tick-batched flushes."""
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.simulation.pool import SimPool
+
+    n_nodes = 64
+    batch_size = 320
+    config = getConfig({
+        "Max3PCBatchSize": batch_size,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": 0.05,
+    })
+    pool = SimPool(n_nodes=n_nodes, seed=11, config=config,
+                   device_quorum=True, shadow_check=False)
+
+    seq = 0
+
+    def submit(count):
+        nonlocal seq
+        for _ in range(count):
+            seq += 1
+            pool.submit_request(seq)
+
+    def min_ordered():
+        return min(len(n.ordered_digests) for n in pool.nodes)
+
+    def run_until(target, budget_s):
+        deadline = time.monotonic() + budget_s
+        while min_ordered() < target and time.monotonic() < deadline:
+            pool.run_for(0.5)
+        return min_ordered()
+
+    # warm-up: compiles the vote-plane step for the n=64 shapes and fills
+    # every jit cache the measured run will hit
+    submit(batch_size)
+    warm = run_until(batch_size, budget_s=240)
+    assert warm >= batch_size, f"warm-up stalled at {warm}"
+
+    n_txns = 10 * batch_size
+    submit(n_txns)
+    t0 = time.perf_counter()
+    got = run_until(batch_size + n_txns, budget_s=300)
+    elapsed = time.perf_counter() - t0
+    ordered = got - batch_size
+    assert pool.honest_nodes_agree()
+    value = ordered / elapsed
+    flushes = pool.vote_group.flushes
+    return {
+        "metric": "ordered_txns_per_sec_n64_device_quorum",
+        "value": round(value, 1),
+        "unit": "txns/sec",
+        "vs_baseline": round(
+            value / ESTIMATED_REFERENCE_ORDERED_TXNS_PER_SEC_N64, 3),
+        "baseline_note": "reference publishes no numbers; vs 100 txns/sec "
+                         "CPU estimate at n=64 (BASELINE.md provenance)",
+        "n_validators": n_nodes,
+        "txns_ordered": ordered,
+        "wall_s": round(elapsed, 2),
+        "device_flushes": flushes,
+    }
+
+
+def bench_catchup_proofs() -> dict:
+    """BASELINE config 5: audit-path proofs verified/sec at >=100k txns.
+    vs_baseline is the host scalar verifier measured on this same machine."""
+    import numpy as np
+
+    from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from indy_plenum_tpu.ledger.merkle_verifier import MerkleVerifier, STH
+    from indy_plenum_tpu.server.catchup.catchup_rep_service import (
+        verify_audit_paths_batch,
     )
+
+    tree_size = 131072
+    batch = 16384
+    rng = np.random.RandomState(5)
+    leaves = [rng.bytes(64) for _ in range(tree_size)]
+    tree = CompactMerkleTree()
+    tree.extend(leaves)
+    root = tree.root_hash
+
+    # a CATCHUP_REP covers a consecutive txn range — the shape the node
+    # dedup in verify_audit_paths_batch is designed for
+    start = 57344
+    idxs = list(range(start, start + batch))
+    data = [leaves[i] for i in idxs]
+    paths = [tree.audit_path(i, tree_size) for i in idxs]
+
+    ok = _retry(lambda: verify_audit_paths_batch(
+        data, idxs, paths, tree_size, root))  # compile + warm
+    assert ok.all(), "audit-path batch failed verification"
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        ok = _retry(lambda: verify_audit_paths_batch(
+            data, idxs, paths, tree_size, root))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    value = batch / best
+
+    # honest same-machine host baseline over a sample, scaled
+    sample = 512
+    v = MerkleVerifier()
+    sth = STH(tree_size=tree_size, sha256_root_hash=root)
+    t0 = time.perf_counter()
+    for d, i, p in zip(data[:sample], idxs[:sample], paths[:sample]):
+        assert v.verify_leaf_inclusion(d, i, p, sth)
+    host_per_sec = sample / (time.perf_counter() - t0)
+    return {
+        "metric": "catchup_audit_proofs_per_sec",
+        "value": round(value, 1),
+        "unit": "proofs/sec",
+        "vs_baseline": round(value / host_per_sec, 3),
+        "baseline_note": "vs host scalar verifier on this machine "
+                         f"({round(host_per_sec, 1)}/sec; host CPU has "
+                         "SHA-NI — the device path is an offload that "
+                         "frees the protocol thread, not a raw-SHA win)",
+        "tree_size": tree_size,
+        "batch": batch,
+        "best_ms": round(best * 1e3, 2),
+    }
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    benches = {
+        "ed": bench_ed25519,
+        "ordered": bench_ordered_txns_n64,
+        "catchup": bench_catchup_proofs,
+    }
+    selected = list(benches) if which == "all" else [which]
+
+    # deterministic failures (asserts) are recorded once, not re-run for
+    # minutes; anything else (transient remote-compile/HTTP errors outside
+    # the per-kernel retries, e.g. inside the sim pool's device calls)
+    # gets exactly one more full attempt
+    results, errors = {}, {}
+    for name in selected:
+        try:
+            results[name] = benches[name]()
+        except AssertionError as ex:
+            traceback.print_exc(file=sys.stderr)
+            errors[name] = f"AssertionError: {ex}"
+        except Exception:  # noqa: BLE001
+            traceback.print_exc(file=sys.stderr)
+            try:
+                results[name] = benches[name]()
+            except Exception as ex:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+                errors[name] = f"{type(ex).__name__}: {ex}"
+
+    # headline: the ed25519 kernel (known-good vs_baseline); fall back to
+    # any metric that succeeded so the round ALWAYS records a number
+    line = None
+    for name in ("ed", "ordered", "catchup"):
+        if name in results:
+            line = dict(results.pop(name))
+            break
+    if line is None:
+        line = {"metric": "bench_failed", "value": 0, "unit": "none",
+                "vs_baseline": 0}
+    extras = [results[n] for n in selected if n in results]
+    if extras:
+        line["extra_metrics"] = extras
+    if errors:
+        line["errors"] = errors
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -66,6 +66,12 @@ class Config:
     VerifyBatchWait: float = 0.005
     DeviceMeshAxis: str = "validators"
     SimValidatorsPerDevice: int = 8
+    # Quorum evaluation cadence when the device vote plane is authoritative.
+    # 0 = evaluate on every message (one padded device flush per query —
+    # correct but unamortized); > 0 = defer quorum queries to a repeating
+    # tick so all votes recorded in between ride ONE device flush
+    # (vote_plane.py's batching contract; the Node event-loop mode).
+    QuorumTickInterval: float = 0.0
 
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory

@@ -56,6 +56,11 @@ class CheckpointService:
         # n-f-1 others + own.
         self._vote_plane = vote_plane
         self._shadow_check = shadow_check
+        # tick-batched mode: a stabilization attempt that fails against the
+        # stale snapshot is retried on the next tick (see service_tick)
+        self._tick_mode = (vote_plane is not None
+                           and self._config.QuorumTickInterval > 0)
+        self._dirty_stabilize: set = set()  # (view_no, seq_no_end)
 
         # digests of ordered batches since the last checkpoint boundary
         self._digests_since: list[str] = []
@@ -138,10 +143,22 @@ class CheckpointService:
                 "checkpoint quorum divergence", key, dev, host)
         return dev
 
+    def service_quorum_tick(self) -> None:
+        """Tick-batched mode: retry stabilizations that failed against the
+        previous snapshot (the caller has already synced the vote plane)."""
+        if not self._dirty_stabilize:
+            return
+        pending, self._dirty_stabilize = self._dirty_stabilize, set()
+        for view_no, seq_no_end in sorted(pending):
+            if seq_no_end > self._data.stable_checkpoint:
+                self._try_stabilize(view_no, seq_no_end)
+
     def _try_stabilize(self, view_no: int, seq_no_end: int) -> None:
         own = self._own_checkpoints.get(seq_no_end)
         if own is None or own.viewNo != view_no:
             return
+        if self._tick_mode:
+            self._dirty_stabilize.add((view_no, seq_no_end))
         if not self._has_quorum(view_no, seq_no_end, own.digest):
             # byzantine check: quorum formed on a DIFFERENT digest for the
             # same seqNoEnd means we diverged

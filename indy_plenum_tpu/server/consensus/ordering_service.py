@@ -173,6 +173,16 @@ class OrderingService:
         # == device verdict on every query (sim/test mode).
         self._vote_plane = vote_plane
         self._shadow_check = shadow_check
+        # tick-batched quorum evaluation (config.QuorumTickInterval > 0):
+        # message handlers only RECORD votes; the runtime composition (the
+        # SimPool / Node event loop) syncs the vote plane once per tick and
+        # then calls service_quorum_tick(), so every vote recorded in the
+        # interval rides one device flush instead of one per message.
+        # Queries read the last-synced snapshot (plane.defer_flush_on_query).
+        self._tick_mode = (vote_plane is not None
+                           and self._config.QuorumTickInterval > 0)
+        self._dirty_prepare_keys: set = set()
+        self._order_dirty = False
 
         # 3PC logs, keyed (view_no, pp_seq_no)
         self.sent_preprepares: Dict[Tuple[int, int], PrePrepare] = {}
@@ -219,6 +229,44 @@ class OrderingService:
 
     def stop(self) -> None:
         self._batch_timer.stop()
+
+    # --- tick-batched quorum evaluation --------------------------------
+
+    def _note_prepare_activity(self, key: Tuple[int, int]) -> None:
+        if self._tick_mode:
+            self._dirty_prepare_keys.add(key)
+        else:
+            self._try_prepared(key)
+
+    def _note_commit_activity(self, key: Tuple[int, int]) -> None:
+        if self._tick_mode:
+            self._order_dirty = True
+        else:
+            self._try_order(key)
+
+    def service_quorum_tick(self) -> None:
+        """Evaluate quorums for everything that moved since the last tick.
+        The caller has already synced the vote plane; queries here (and any
+        triggered by messages until the next tick) read that snapshot, so
+        votes recorded during the tick wave buffer for the next flush."""
+        keys: set = set()
+        if self._dirty_prepare_keys:
+            keys, self._dirty_prepare_keys = self._dirty_prepare_keys, set()
+            for key in sorted(keys):
+                self._try_prepared(key)
+            self._order_dirty = True
+        if self._order_dirty:
+            self._order_dirty = False
+            self._try_order(self._data.last_ordered_3pc)
+        if self._vote_plane is not None \
+                and self._vote_plane.has_buffered_votes:
+            # votes recorded DURING this tick (e.g. our own COMMIT sent by
+            # _try_prepared above) are not in the snapshot we just read;
+            # they may complete a quorum with no further inbound message,
+            # so re-arm evaluation for the next tick (lost-wakeup guard)
+            self._order_dirty = True
+            self._dirty_prepare_keys |= {
+                k for k in keys if k not in self.ordered}
 
     @property
     def name(self) -> str:
@@ -414,7 +462,7 @@ class OrderingService:
 
         if not self._data.is_primary_in_view:
             self._send_prepare(pp)
-        self._try_prepared(key)
+        self._note_prepare_activity(key)
         # the successor PRE-PREPARE may be waiting on this one
         self._stasher.process_stashed(STASH_WAITING_PREV_PP)
         return PROCESS
@@ -461,7 +509,7 @@ class OrderingService:
             # pp present => digest checked above; safe to scatter
             self._vote_plane.record_prepare(sender, prepare.ppSeqNo)
         self._bls.process_prepare(prepare, sender)
-        self._try_prepared(key)
+        self._note_prepare_activity(key)
         return PROCESS
 
     def _dict_prepare_quorum(self, key: Tuple[int, int]) -> bool:
@@ -508,7 +556,7 @@ class OrderingService:
         if self._vote_plane is not None:
             self._vote_plane.record_commit(self.name, pp.ppSeqNo)
         self._network.send(commit)
-        self._try_order(key)
+        self._note_commit_activity(key)
 
     def process_commit(self, commit: Commit, sender: str):
         key = (commit.viewNo, commit.ppSeqNo)
@@ -529,7 +577,7 @@ class OrderingService:
         if self._vote_plane is not None:
             self._vote_plane.record_commit(sender, commit.ppSeqNo)
         self._bls.process_commit(commit, sender)
-        self._try_order(key)
+        self._note_commit_activity(key)
         return PROCESS
 
     # ------------------------------------------------------------------
@@ -624,6 +672,7 @@ class OrderingService:
             # old-view votes are void; slots refill during re-ordering
             self._vote_plane.reset(h=self._data.low_watermark)
         self._pending_old_view_bids.clear()
+        self._dirty_prepare_keys.clear()
         self._fetch_timer.stop()
         self.sent_preprepares.clear()
         self.prePrepares.clear()

@@ -143,7 +143,22 @@ class SimNode:
                  network: SimNetwork, requests: SimRequestsPool,
                  config: Config, device_quorum: bool = False,
                  domain_genesis: Optional[list] = None,
-                 storage=None, bls_keys=None):
+                 storage=None, bls_keys=None,
+                 shadow_check: Optional[bool] = None,
+                 vote_plane=None):
+        # shadow_check default: on whenever the device plane decides, so
+        # tests continuously prove host/device equivalence. The bench turns
+        # it off to run the device plane as the SOLE quorum authority.
+        # Tick-batched mode is incompatible with shadow checks by design:
+        # the device snapshot is deliberately one tick stale while the host
+        # dicts are live, so equivalence asserts would fire spuriously.
+        if shadow_check is None:
+            shadow_check = device_quorum and config.QuorumTickInterval == 0
+        if shadow_check and config.QuorumTickInterval > 0:
+            raise ValueError(
+                "shadow_check cannot be combined with QuorumTickInterval>0:"
+                " deferred device snapshots intentionally lag the host"
+                " tallies")
         self.name = name
         self.config = config
         self.data = ConsensusSharedData(
@@ -174,8 +189,8 @@ class SimNode:
             self.executor = SimExecutor()
         self.requests_view = requests.view_for(name)
 
-        self.vote_plane = None
-        if device_quorum:
+        self.vote_plane = vote_plane
+        if device_quorum and self.vote_plane is None:
             from ..tpu.vote_plane import DeviceVotePlane
 
             self.vote_plane = DeviceVotePlane(
@@ -215,11 +230,11 @@ class SimNode:
             network=self.external_bus, stasher=self.stasher,
             executor=self.executor, requests=self.requests_view,
             config=config, vote_plane=self.vote_plane,
-            shadow_check=device_quorum, bls=self.bls_replica)
+            shadow_check=shadow_check, bls=self.bls_replica)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher, config=config,
-            vote_plane=self.vote_plane, shadow_check=device_quorum)
+            vote_plane=self.vote_plane, shadow_check=shadow_check)
         self.view_changer = ViewChangeService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher,
@@ -315,7 +330,8 @@ class SimPool:
                  device_quorum: bool = False,
                  real_execution: bool = False,
                  sign_requests: bool = False,
-                 bls: bool = False):
+                 bls: bool = False,
+                 shadow_check: Optional[bool] = None):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -356,13 +372,45 @@ class SimPool:
                     hashlib.sha256(b"sim-bls-" + name.encode()).digest())
                 for name in self.validators}
 
+        # all nodes share ONE stacked device plane (member axis vmapped):
+        # votes for the whole pool ride a single dispatch per flush
+        self.vote_group = None
+        if device_quorum:
+            from ..tpu.vote_plane import VotePlaneGroup
+
+            self.vote_group = VotePlaneGroup(
+                n_nodes, self.validators, log_size=self.config.LOG_SIZE,
+                n_checkpoints=max(
+                    1, self.config.LOG_SIZE // self.config.CHK_FREQ))
+
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
                     self.requests, self.config, device_quorum=device_quorum,
                     domain_genesis=domain_genesis if real_execution else None,
-                    bls_keys=self.bls_keys)
-            for name in self.validators]
+                    bls_keys=self.bls_keys, shadow_check=shadow_check,
+                    vote_plane=(self.vote_group.view(i)
+                                if self.vote_group else None))
+            for i, name in enumerate(self.validators)]
         self.network.connect_all()
+
+        # tick-batched quorum mode: ONE group flush per tick serves the
+        # whole pool; services evaluate against that snapshot and votes
+        # recorded during the wave buffer for the next tick
+        self._quorum_tick_timer = None
+        if self.vote_group is not None and self.config.QuorumTickInterval > 0:
+            from ..common.timer import RepeatingTimer
+
+            for node in self.nodes:
+                node.vote_plane.defer_flush_on_query = True
+            self._quorum_tick_timer = RepeatingTimer(
+                self.timer, self.config.QuorumTickInterval,
+                self._pool_quorum_tick)
+
+    def _pool_quorum_tick(self) -> None:
+        self.vote_group.flush()
+        for node in self.nodes:
+            node.ordering.service_quorum_tick()
+            node.checkpoints.service_quorum_tick()
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)

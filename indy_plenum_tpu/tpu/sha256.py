@@ -132,21 +132,14 @@ def merkle_node_hash(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
         jnp.concatenate([prefix, left, right], axis=-1), 65)
 
 
-def _verify_audit_paths(leaf_hash: jnp.ndarray, index: jnp.ndarray,
-                        path: jnp.ndarray, path_len: jnp.ndarray,
-                        tree_size: jnp.ndarray,
-                        root: jnp.ndarray) -> jnp.ndarray:
-    """Batched RFC 6962 audit-path fold.
-
-    leaf_hash (B, 32) uint8; index (B,) int32; path (B, D, 32) uint8 padded;
-    path_len (B,) int32 actual depths; tree_size (B,) int32; root (B, 32).
-    Returns (B,) bool. D is the static max depth.
-    """
-    depth = path.shape[-2]
+def _audit_fold(leaf_hash: jnp.ndarray, index: jnp.ndarray,
+                get_sibling, depth: int, path_len: jnp.ndarray,
+                tree_size: jnp.ndarray, root: jnp.ndarray) -> jnp.ndarray:
+    """Shared RFC 6962 audit-path fold; ``get_sibling(level) -> (B, 32)``."""
 
     def body(carry, level):
         r, fn, fsn, consumed, ok = carry
-        sibling = path[..., level, :]
+        sibling = get_sibling(level)
         active = level < path_len
         use_left = (fn % 2 == 1) | (fn == fsn)  # sibling on the left
         left = jnp.where(use_left[..., None], sibling, r)
@@ -176,7 +169,41 @@ def _verify_audit_paths(leaf_hash: jnp.ndarray, index: jnp.ndarray,
     return ok & jnp.all(r == root, axis=-1)
 
 
+def _verify_audit_paths(leaf_hash: jnp.ndarray, index: jnp.ndarray,
+                        path: jnp.ndarray, path_len: jnp.ndarray,
+                        tree_size: jnp.ndarray,
+                        root: jnp.ndarray) -> jnp.ndarray:
+    """Batched RFC 6962 audit-path fold (dense per-item paths).
+
+    leaf_hash (B, 32) uint8; index (B,) int32; path (B, D, 32) uint8 padded;
+    path_len (B,) int32 actual depths; tree_size (B,) int32; root (B, 32).
+    Returns (B,) bool. D is the static max depth.
+    """
+    return _audit_fold(leaf_hash, index, lambda level: path[..., level, :],
+                       path.shape[-2], path_len, tree_size, root)
+
+
+def _verify_audit_paths_indexed(leaf_hash: jnp.ndarray, index: jnp.ndarray,
+                                node_table: jnp.ndarray,
+                                path_idx: jnp.ndarray,
+                                path_len: jnp.ndarray,
+                                tree_size: jnp.ndarray,
+                                root: jnp.ndarray) -> jnp.ndarray:
+    """Audit-path fold over a deduplicated node table.
+
+    Consecutive txn ranges (the catchup shape) share most sibling nodes, so
+    the host sends node_table (U, 32) uint8 + path_idx (B, D) int32 instead
+    of (B, D, 32) raw paths — an order of magnitude less host->device
+    traffic for CATCHUP_REP verification.
+    """
+    return _audit_fold(
+        leaf_hash, index,
+        lambda level: node_table[path_idx[..., level], :],
+        path_idx.shape[-1], path_len, tree_size, root)
+
+
 verify_audit_paths = jax.jit(_verify_audit_paths)
+verify_audit_paths_indexed = jax.jit(_verify_audit_paths_indexed)
 
 
 def sha256_host_oracle(data: bytes) -> bytes:  # pragma: no cover - test aid

@@ -45,8 +45,7 @@ def _step(state: q.VoteState, msgs: q.MsgBatch, n_validators: int):
     return q.step(state, msgs, n_validators)
 
 
-@jax.jit
-def _slide(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
+def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
     """Roll the slot axis left by ``delta`` and zero the vacated columns."""
     s = state.prepare_votes.shape[1]
     cols = jnp.arange(s)
@@ -62,9 +61,31 @@ def _slide(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
         preprepare_seen=roll1(state.preprepare_seen),
         prepare_votes=roll2(state.prepare_votes),
         commit_votes=roll2(state.commit_votes),
-        checkpoint_votes=jnp.zeros_like(state.checkpoint_votes),
+        # delta == 0 must be a strict identity (the vmapped group slide
+        # passes 0 for every member but the one actually sliding)
+        checkpoint_votes=jnp.where(delta > 0, 0,
+                                   state.checkpoint_votes),
         ordered=roll1(state.ordered),
     )
+
+
+_slide = jax.jit(_slide_core)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _group_step(states: q.VoteState, msgs: q.MsgBatch, n_validators: int):
+    """Vmapped step over a leading member axis: M planes, ONE dispatch."""
+    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+
+
+@jax.jit
+def _group_slide(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
+    return jax.vmap(_slide_core)(states, deltas)
+
+
+@jax.jit
+def _group_zero_member(states: q.VoteState, member: jnp.ndarray) -> q.VoteState:
+    return jax.tree.map(lambda x: x.at[member].set(0), states)
 
 
 class DeviceVotePlane:
@@ -88,12 +109,24 @@ class DeviceVotePlane:
         self._host_commit_counts: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
         self.flushes = 0
+        # tick-batched mode: quorum queries read the last-synced snapshot
+        # instead of flushing per query. There is NO built-in driver: the
+        # runtime composition that sets this flag must call sync() (or, in
+        # group mode, VotePlaneGroup.flush — what SimPool's tick does) once
+        # per tick, or snapshots go permanently stale.
+        self.defer_flush_on_query = False
 
     # --- recording ------------------------------------------------------
 
     @property
     def h(self) -> int:
         return self._h
+
+    @property
+    def has_buffered_votes(self) -> bool:
+        """True if votes recorded since the last flush are still host-side
+        (tick mode's lost-wakeup guard checks this)."""
+        return bool(self._pending)
 
     def _slot(self, pp_seq_no: int) -> Optional[int]:
         slot = pp_seq_no - self._h - 1
@@ -163,6 +196,7 @@ class DeviceVotePlane:
         self._state = _slide(self._state, jnp.int32(new_h - self._h))
         self._h = new_h
         self._events = None
+        self._host_prepared = None  # snapshot is void, even in defer mode
 
     def reset(self, h: Optional[int] = None) -> None:
         """View change: clear all votes (they were for the old view)."""
@@ -171,6 +205,7 @@ class DeviceVotePlane:
         self._state = q.init_state(self._n, self._log_size, self._n_chk)
         self._pending.clear()
         self._events = None
+        self._host_prepared = None  # snapshot is void, even in defer mode
 
     # --- flush + queries ------------------------------------------------
 
@@ -182,17 +217,26 @@ class DeviceVotePlane:
             self._state, self._events = _step(self._state, msgs, self._n)
             self.flushes += 1
 
+    def _refresh(self) -> None:
+        self._flush()
+        if self._events is None:  # nothing ever recorded
+            self._state, self._events = _step(
+                self._state, q.pack_messages([], FLUSH_BATCH), self._n)
+        self._host_prepared = np.asarray(self._events.prepared)
+        self._host_prepare_counts = np.asarray(self._events.prepare_counts)
+        self._host_commit_counts = np.asarray(self._events.commit_counts)
+        self._host_stable = np.asarray(self._events.stable_checkpoints)
+
+    def sync(self) -> None:
+        """Flush all buffered votes and refresh the host snapshot (the
+        per-tick entry point in tick-batched mode)."""
+        self._refresh()
+
     def events(self) -> q.QuorumEvents:
-        if self._pending or self._events is None:
-            self._flush()
-            if self._events is None:  # nothing ever recorded
-                self._state, self._events = _step(
-                    self._state, q.pack_messages([], FLUSH_BATCH), self._n)
-            self._host_prepared = np.asarray(self._events.prepared)
-            self._host_prepare_counts = np.asarray(
-                self._events.prepare_counts)
-            self._host_commit_counts = np.asarray(self._events.commit_counts)
-            self._host_stable = np.asarray(self._events.stable_checkpoints)
+        if self._host_prepared is None or (
+                not self.defer_flush_on_query
+                and (self._pending or self._events is None)):
+            self._refresh()
         return self._events
 
     def has_prepare_quorum(self, pp_seq_no: int) -> bool:
@@ -217,3 +261,165 @@ class DeviceVotePlane:
             return 0
         self.events()
         return int(self._host_prepare_counts[slot])
+
+
+def _pack_group_messages(chunks: List[List[tuple]], max_batch: int
+                         ) -> q.MsgBatch:
+    """(M lists of (kind, sender, slot)) -> one stacked (M, B) MsgBatch."""
+    m = len(chunks)
+    kind = np.zeros((m, max_batch), np.int32)
+    sender = np.zeros((m, max_batch), np.int32)
+    slot = np.zeros((m, max_batch), np.int32)
+    valid = np.zeros((m, max_batch), bool)
+    for j, entries in enumerate(chunks):
+        for i, (k, s, sl) in enumerate(entries):
+            kind[j, i], sender[j, i], slot[j, i], valid[j, i] = k, s, sl, True
+    return q.MsgBatch(kind=jnp.asarray(kind), sender=jnp.asarray(sender),
+                      slot=jnp.asarray(slot), valid=jnp.asarray(valid))
+
+
+class VotePlaneGroup:
+    """M stacked vote planes stepped in ONE vmapped device dispatch.
+
+    The "one pod co-processes the pool" configuration from BASELINE.json's
+    north star: every simulated node holds a :class:`_MemberPlane` view onto
+    a shared (M, ...) tensor stack; when any member queries quorum state,
+    ALL members' buffered votes ride a single (M, FLUSH_BATCH) scatter.
+    Against a high-latency device link this is the difference between one
+    round-trip per node per tick and one per tick for the whole pool.
+    """
+
+    def __init__(self, n_members: int, validators: List[str], log_size: int,
+                 n_checkpoints: int = 4, h: int = 0):
+        self._n = len(validators)
+        self._log_size = log_size
+        self._n_chk = n_checkpoints
+        proto = q.init_state(self._n, log_size, n_checkpoints)
+        self._states = jax.tree.map(
+            lambda x: jnp.zeros((n_members,) + x.shape, x.dtype), proto)
+        self._members = [
+            _MemberPlane(self, i, validators, log_size, n_checkpoints, h)
+            for i in range(n_members)]
+        self.version = 0  # bumped on every device-state change
+        self._host_prepared: Optional[np.ndarray] = None
+        self._host_prepare_counts: Optional[np.ndarray] = None
+        self._host_commit_counts: Optional[np.ndarray] = None
+        self._host_stable: Optional[np.ndarray] = None
+        self.flushes = 0
+
+    def view(self, member_idx: int) -> "DeviceVotePlane":
+        return self._members[member_idx]
+
+    def flush(self) -> None:
+        """Scatter every member's pending votes; refresh host event caches."""
+        if (not any(m._pending for m in self._members)
+                and self._host_prepared is not None):
+            return
+        stepped = False
+        while any(m._pending for m in self._members):
+            chunks = []
+            for m in self._members:
+                take, m._pending = (m._pending[:FLUSH_BATCH],
+                                    m._pending[FLUSH_BATCH:])
+                chunks.append(take)
+            msgs = _pack_group_messages(chunks, FLUSH_BATCH)
+            self._states, events = _group_step(self._states, msgs, self._n)
+            self.flushes += 1
+            stepped = True
+        if not stepped:  # cold start: no votes recorded anywhere yet
+            msgs = _pack_group_messages(
+                [[] for _ in self._members], FLUSH_BATCH)
+            self._states, events = _group_step(self._states, msgs, self._n)
+            self.flushes += 1
+        self._host_prepared = np.asarray(events.prepared)
+        self._host_prepare_counts = np.asarray(events.prepare_counts)
+        self._host_commit_counts = np.asarray(events.commit_counts)
+        self._host_stable = np.asarray(events.stable_checkpoints)
+        self.version += 1
+
+    def slide_member(self, member_idx: int, delta: int) -> None:
+        self.flush()
+        deltas = np.zeros(len(self._members), np.int32)
+        deltas[member_idx] = delta
+        self._states = _group_slide(self._states, jnp.asarray(deltas))
+        self.version += 1
+        self._host_prepared = None
+
+    def reset_member(self, member_idx: int) -> None:
+        # pending for this member was cleared by the caller; other members'
+        # buffered votes are untouched (flushed on their next query)
+        self._states = _group_zero_member(
+            self._states, jnp.int32(member_idx))
+        self.version += 1
+        self._host_prepared = None
+
+
+class _MemberPlane(DeviceVotePlane):
+    """One member's view of a :class:`VotePlaneGroup` (same interface as a
+    standalone :class:`DeviceVotePlane`; storage and flushing are shared)."""
+
+    def __init__(self, group: VotePlaneGroup, member_idx: int,
+                 validators: List[str], log_size: int, n_checkpoints: int,
+                 h: int):
+        self._group = group
+        self._mi = member_idx
+        self._validators = list(validators)
+        self._index = {name: i for i, name in enumerate(self._validators)}
+        self._n = len(self._validators)
+        self._log_size = log_size
+        self._n_chk = n_checkpoints
+        self._h = h
+        self._pending: List[tuple] = []
+        self._events = None
+        self._seen_version = -1
+        self._host_prepared = None
+        self._host_prepare_counts = None
+        self._host_commit_counts = None
+        self._host_stable = None
+        self.defer_flush_on_query = False
+
+    @property
+    def flushes(self) -> int:
+        return self._group.flushes
+
+    @flushes.setter
+    def flushes(self, value) -> None:  # base-class compat; group owns it
+        pass
+
+    def _flush(self) -> None:
+        self._group.flush()
+
+    def _copy_slices(self) -> None:
+        self._host_prepared = self._group._host_prepared[self._mi]
+        self._host_prepare_counts = self._group._host_prepare_counts[self._mi]
+        self._host_commit_counts = self._group._host_commit_counts[self._mi]
+        self._host_stable = self._group._host_stable[self._mi]
+        self._seen_version = self._group.version
+        self._events = True
+
+    def _refresh(self) -> None:
+        self._group.flush()
+        self._copy_slices()
+
+    def events(self):
+        if (self._group._host_prepared is None
+                or (not self.defer_flush_on_query
+                    and (self._pending or self._events is None))):
+            self._refresh()
+        elif self._seen_version != self._group.version:
+            self._copy_slices()
+        return self._events
+
+    def slide_to(self, new_h: int) -> None:
+        if new_h <= self._h:
+            return
+        self._group.slide_member(self._mi, new_h - self._h)
+        self._h = new_h
+        self._events = None
+
+    def reset(self, h: Optional[int] = None) -> None:
+        if h is not None:
+            self._h = h
+        self._pending.clear()
+        self._group.reset_member(self._mi)
+        self._events = None

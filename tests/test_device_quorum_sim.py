@@ -76,3 +76,52 @@ def test_device_plane_survives_view_change():
     logs = [tuple(n.ordered_digests) for n in survivors]
     assert len(set(logs)) == 1
     assert len(logs[0]) == 9
+
+
+def test_tick_batched_sole_authority_orders_and_checkpoints():
+    """Tick-batched mode (the bench/Node-event-loop configuration): no host
+    shadow tallies, quorum queries read per-tick snapshots of the grouped
+    vote plane, and the WHOLE pool's votes ride one vmapped flush per tick.
+    Checkpoint stabilization must also progress (retried via service_tick
+    when the snapshot was stale at message time)."""
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+                        "CHK_FREQ": 5, "LOG_SIZE": 15,
+                        "QuorumTickInterval": 0.05})
+    pool = SimPool(4, seed=31, config=config, device_quorum=True,
+                   shadow_check=False)
+    for i in range(24):
+        pool.submit_request(i)
+    pool.run_for(30)
+    assert pool.honest_nodes_agree()
+    for node in pool.nodes:
+        assert len(node.ordered_digests) == 24, node.name
+        assert node.data.stable_checkpoint >= 10, node.name
+        assert node.vote_plane.h == node.data.low_watermark
+    # amortization: far fewer group flushes than messages processed
+    assert pool.vote_group.flushes < pool.network.sent / 4
+
+
+def test_tick_batched_survives_view_change():
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+                        "QuorumTickInterval": 0.05})
+    pool = SimPool(4, seed=32, config=config, device_quorum=True,
+                   shadow_check=False)
+    primary_name = pool.nodes[0].data.primaries[0]
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert all(len(n.ordered_digests) == 4 for n in pool.nodes)
+
+    pool.network.disconnect(primary_name)
+    pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+    survivors = [n for n in pool.nodes if n.name != primary_name]
+    for node in survivors:
+        assert node.data.view_no >= 1, node.name
+        assert not node.data.waiting_for_new_view, node.name
+
+    for i in range(100, 105):
+        pool.submit_request(i)
+    pool.run_for(15)
+    logs = [tuple(n.ordered_digests) for n in survivors]
+    assert len(set(logs)) == 1
+    assert len(logs[0]) == 9

@@ -262,6 +262,42 @@ class CatchupRep(MessageBase):
 
 
 @register
+class Reply(MessageBase):
+    """Node -> client: the committed txn for an executed request
+    (reference: plenum/common/messages/node_messages.py Reply)."""
+
+    typename = "REPLY"
+    schema = (
+        ("result", AnyField()),  # the committed txn incl. seqNo + roots
+    )
+
+
+@register
+class RequestAck(MessageBase):
+    """Node -> client: request accepted into propagation."""
+
+    typename = "REQACK"
+    schema = (
+        ("identifier", LimitedLengthStringField(max_length=256,
+                                                nullable=True)),
+        ("reqId", NonNegativeNumberField()),
+    )
+
+
+@register
+class RequestNack(MessageBase):
+    """Node -> client: request rejected at ingress (bad signature, replay)."""
+
+    typename = "REQNACK"
+    schema = (
+        ("identifier", LimitedLengthStringField(max_length=256,
+                                                nullable=True)),
+        ("reqId", NonNegativeNumberField()),
+        ("reason", LimitedLengthStringField(max_length=512)),
+    )
+
+
+@register
 class MessageReq(MessageBase):
     typename = "MESSAGE_REQUEST"
     schema = (

@@ -20,6 +20,7 @@ from ...common.messages.node_messages import (
     PrePrepare,
     Prepare,
     Commit,
+    Propagate,
     ViewChange,
 )
 from ...common.stashing_router import DISCARD, PROCESS, StashingRouter
@@ -31,6 +32,7 @@ PREPARE = "PREPARE"
 COMMIT = "COMMIT"
 VIEW_CHANGE = "VIEW_CHANGE"
 OLD_VIEW_PREPREPARE = "OLD_VIEW_PREPREPARE"
+PROPAGATE = "PROPAGATE"
 
 
 class MessageReqService:
@@ -41,12 +43,14 @@ class MessageReqService:
                  bus: InternalBus,
                  network: ExternalBus,
                  ordering_service=None,
-                 view_change_service=None):
+                 view_change_service=None,
+                 propagator=None):
         self._data = data
         self._bus = bus
         self._network = network
         self._ordering = ordering_service
         self._view_change = view_change_service
+        self._propagator = propagator
         # (msg_type, params_key) we actually asked for; unsolicited
         # MESSAGE_RESPONSEs are dropped
         self._outstanding: set = set()
@@ -79,6 +83,9 @@ class MessageReqService:
             orig_view, pp_seq_no, digest = msg.key
             params = {"originalViewNo": orig_view, "ppSeqNo": pp_seq_no,
                       "digest": digest}
+        elif msg.msg_type == PROPAGATE:
+            # broadcast: the digest authenticates the carried request
+            params = {"digest": str(msg.key)}
         else:
             return
         self._outstanding.add((msg.msg_type, self._params_key(params)))
@@ -98,6 +105,7 @@ class MessageReqService:
             COMMIT: self._find_commit,
             VIEW_CHANGE: self._find_view_change,
             OLD_VIEW_PREPREPARE: self._find_old_view_preprepare,
+            PROPAGATE: self._find_propagate,
         }.get(req.msg_type)
         if handler is None:
             return DISCARD, f"unknown msg_type {req.msg_type}"
@@ -153,6 +161,14 @@ class MessageReqService:
                     return pp
         return found
 
+    def _find_propagate(self, params):
+        if self._propagator is None:
+            return None
+        digest = params.get("digest")
+        if not digest:
+            return None
+        return self._propagator.find_propagate(str(digest))
+
     def _find_view_change(self, params):
         if self._view_change is None:
             return None
@@ -187,9 +203,24 @@ class MessageReqService:
             return DISCARD, f"bad payload: {exc}"
         expected = {PREPREPARE: PrePrepare, PREPARE: Prepare,
                     COMMIT: Commit, VIEW_CHANGE: ViewChange,
-                    OLD_VIEW_PREPREPARE: PrePrepare}.get(rep.msg_type)
+                    OLD_VIEW_PREPREPARE: PrePrepare,
+                    PROPAGATE: Propagate}.get(rep.msg_type)
         if expected is None or not isinstance(msg, expected):
             return DISCARD, "payload type mismatch"
+        if rep.msg_type == PROPAGATE:
+            # the carried request must hash to the digest we asked for —
+            # the responder cannot substitute a different request
+            from ...common.request import Request
+
+            try:
+                digest = Request.from_dict(dict(msg.request)).digest
+            except Exception as exc:  # noqa: BLE001 — untrusted wire data
+                return DISCARD, f"bad PROPAGATE payload: {exc}"
+            if digest != str(rep.params.get("digest")):
+                return DISCARD, "PROPAGATE digest mismatch"
+            self._outstanding.discard(key)
+            self._network.process_incoming(msg, sender)
+            return PROCESS
         if rep.msg_type == OLD_VIEW_PREPREPARE:
             # content is authenticated by the digest we asked for (it came
             # out of NEW_VIEW's weak-quorum-supported batch id)

@@ -1,0 +1,381 @@
+"""The Node: composition root of one validator.
+
+Reference: plenum/server/node.py (`Node`) — there a ~4000-line god class;
+here a thin composition root that OWNS the seams the simulation previously
+faked (SimRequestsPool's shared-pool fiction): client ingress with
+device-batched authentication, PROPAGATE dissemination with per-node f+1
+finalisation, replay protection, execution with Reply emission, and the
+full consensus service stack.
+
+Ingress pipeline (the north-star hot path):
+    client request -> replay check (ReqIdrToTxn) -> auth queue ->
+    [one device batch per PropagateBatchWait tick:
+     CoreAuthNr.authenticate_batch] -> Propagator.propagate ->
+    f+1 PROPAGATE quorum -> finalised -> NodeRequestsPool ->
+    OrderingService 3PC -> Ordered -> execute/commit -> Reply.
+
+Verkey resolution is STATE-BACKED: CoreAuthNr reads the signer's NYM from
+the domain SMT (NymHandler.get_nym_data), so an identity written by a
+committed NYM txn can authenticate follow-up requests with no static key
+material beyond the genesis seed.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..common.constants import DOMAIN_LEDGER_ID
+from ..common.event_bus import InternalBus
+from ..common.messages.internal_messages import (
+    CatchupFinished,
+    MissingMessage,
+    RequestPropagates,
+)
+from ..common.messages.node_messages import (
+    Ordered,
+    Propagate,
+    Reply,
+    RequestAck,
+    RequestNack,
+)
+from ..common.request import Request
+from ..common.stashing_router import StashingRouter
+from ..common.timer import RepeatingTimer, TimerService
+from ..config import Config, getConfig
+from ..storage.req_id_to_txn import ReqIdrToTxn
+from .client_authn import CoreAuthNr
+from .consensus.checkpoint_service import CheckpointService
+from .consensus.consensus_shared_data import ConsensusSharedData
+from .consensus.message_req_service import MessageReqService
+from .consensus.ordering_service import OrderingService, RequestsPool
+from .consensus.primary_connection_monitor_service import (
+    PrimaryConnectionMonitorService,
+)
+from .consensus.primary_selector import (
+    RoundRobinConstantNodesPrimariesSelector,
+)
+from .consensus.view_change_service import ViewChangeService
+from .consensus.view_change_trigger_service import ViewChangeTriggerService
+from .ledgers_bootstrap import LedgersBootstrap, NodeStorage
+from .propagator import Propagator
+from .request_managers.write_request_manager import NodeExecutor
+
+logger = logging.getLogger(__name__)
+
+
+class NodeRequestsPool(RequestsPool):
+    """Per-node finalised-request queues, backed by this node's Propagator
+    (replaces the simulation's shared-pool fiction)."""
+
+    def __init__(self, propagator: Propagator, classify):
+        self._propagator = propagator
+        self._classify = classify  # Request -> ledger_id
+        self._queues: Dict[int, List[str]] = {}
+
+    def enqueue(self, request: Request) -> None:
+        lid = self._classify(request)
+        if lid is None:
+            lid = DOMAIN_LEDGER_ID
+        self._queues.setdefault(lid, []).append(request.digest)
+
+    def pop_ready(self, ledger_id: int, max_count: int) -> List[Request]:
+        q = self._queues.get(ledger_id, [])
+        take, self._queues[ledger_id] = q[:max_count], q[max_count:]
+        return [self._propagator.get(d) for d in take]
+
+    def get(self, digest: str) -> Optional[Request]:
+        return self._propagator.get(digest)
+
+    def has_ready(self, ledger_id: int) -> bool:
+        return bool(self._queues.get(ledger_id))
+
+    def ledger_ids_with_ready(self) -> List[int]:
+        return [lid for lid, q in self._queues.items() if q]
+
+    def mark_ordered(self, digests) -> None:
+        gone = set(digests)
+        for lid, q in self._queues.items():
+            self._queues[lid] = [d for d in q if d not in gone]
+
+
+class Node:
+    """One validator: ingress + propagation + consensus + execution."""
+
+    def __init__(self,
+                 name: str,
+                 validators: List[str],
+                 timer: TimerService,
+                 network,  # provides create_peer(name) -> ExternalBus
+                 config: Optional[Config] = None,
+                 storage: Optional[NodeStorage] = None,
+                 pool_genesis: Optional[List[Dict]] = None,
+                 domain_genesis: Optional[List[Dict]] = None,
+                 seed_keys: Optional[Dict[str, str]] = None,
+                 bls_keys=None,
+                 vote_plane=None,
+                 drive_quorum_ticks: bool = True):
+        self.name = name
+        self.config = config or getConfig()
+        self.timer = timer
+        self.data = ConsensusSharedData(
+            name, validators, inst_id=0, is_master=True,
+            log_size=self.config.LOG_SIZE)
+        selector = RoundRobinConstantNodesPrimariesSelector(validators)
+        self.data.primaries = selector.select_primaries(0, 1)
+
+        self.internal_bus = InternalBus()
+        self.external_bus = network.create_peer(name)
+        self.stasher = StashingRouter(
+            limit=1000, buses=[self.internal_bus, self.external_bus])
+
+        # --- persistence + execution -----------------------------------
+        self.boot = LedgersBootstrap(
+            storage=storage, pool_genesis=pool_genesis,
+            domain_genesis=domain_genesis).build()
+        self.executor = NodeExecutor(
+            self.boot.write_manager,
+            get_view_info=lambda: (self.data.view_no,
+                                   list(self.data.primaries)))
+        self.req_idr_to_txn = ReqIdrToTxn()
+
+        # --- ingress: state-backed authn + propagation ------------------
+        self.authnr = CoreAuthNr(verkey_source=self.boot.nym_handler,
+                                 seed_keys=seed_keys)
+        self.propagator = Propagator(
+            name, self.data.quorums, self.external_bus,
+            on_finalised=self._on_request_finalised,
+            on_needs_auth=self._enqueue_for_auth,
+            is_already_committed=lambda r: self.req_idr_to_txn
+            .get_by_payload_digest(r.payload_digest) is not None)
+        self.requests_pool = NodeRequestsPool(
+            self.propagator,
+            classify=self.boot.write_manager.ledger_id_for_request)
+        self.stasher.subscribe(Propagate, self.propagator.process_propagate)
+        self._auth_queue: List[Request] = []
+        # client message surface: digest -> client id, and the outbound
+        # client messages (REQACK/REQNACK/REPLY) a transport would deliver
+        self._req_clients: Dict[str, str] = {}
+        self.client_outbox: List[tuple] = []  # (client_id, message)
+        self.replies: Dict[str, Reply] = {}  # digest -> Reply
+
+        # --- BLS --------------------------------------------------------
+        self.bls_replica = None
+        if bls_keys is not None:
+            from ..bls.factory import create_bls_bft_replica
+            from ..common.constants import POOL_LEDGER_ID
+            from ..common.messages.internal_messages import RaisedSuspicion
+            from ..utils.base58 import b58encode
+
+            own_kp, pool_keys = bls_keys[name], {
+                n: (pk, pop) for n, (kp, pk, pop) in bls_keys.items()}
+
+            def pool_root():
+                return b58encode(self.boot.db.get_state(
+                    POOL_LEDGER_ID).committed_head_hash)
+
+            def bls_suspicion(ex):
+                self.internal_bus.send(RaisedSuspicion(inst_id=0, ex=ex))
+
+            self.bls_replica = create_bls_bft_replica(
+                name, own_kp[0], pool_keys,
+                pool_state_root_provider=pool_root,
+                suspicion_sink=bls_suspicion)
+
+        # --- consensus services -----------------------------------------
+        self.ordering = OrderingService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            executor=self.executor, requests=self.requests_pool,
+            config=self.config, vote_plane=vote_plane,
+            bls=self.bls_replica)
+        self.checkpoints = CheckpointService(
+            data=self.data, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            config=self.config, vote_plane=vote_plane)
+        self.view_changer = ViewChangeService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            checkpoint_values_provider=self.checkpoints.own_checkpoint_values,
+            config=self.config)
+        self.vc_trigger = ViewChangeTriggerService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, stasher=self.stasher,
+            config=self.config)
+        self.primary_monitor = PrimaryConnectionMonitorService(
+            data=self.data, timer=timer, bus=self.internal_bus,
+            network=self.external_bus, config=self.config)
+        self.message_req = MessageReqService(
+            data=self.data, bus=self.internal_bus,
+            network=self.external_bus, ordering_service=self.ordering,
+            view_change_service=self.view_changer,
+            propagator=self.propagator)
+
+        # --- catchup ----------------------------------------------------
+        from ..common.messages.internal_messages import RaisedSuspicion
+        from .catchup import NodeLeecherService, SeederService
+
+        self.seeder = SeederService(
+            self.external_bus, self.boot.db, own_name=name)
+
+        def catchup_suspicion(ex):
+            self.internal_bus.send(RaisedSuspicion(inst_id=0, ex=ex))
+
+        self.leecher = NodeLeecherService(
+            data=self.data, bus=self.internal_bus,
+            network=self.external_bus, timer=timer, bootstrap=self.boot,
+            config=self.config, suspicion_sink=catchup_suspicion)
+
+        # --- execution + client replies ---------------------------------
+        self.ordered_log: List[Ordered] = []
+        self.executed_upto = self.executor.committed_seq()
+        self.internal_bus.subscribe(Ordered, self._on_ordered)
+        self.internal_bus.subscribe(CatchupFinished,
+                                    self._on_catchup_finished)
+        self.internal_bus.subscribe(RequestPropagates,
+                                    self._on_request_propagates)
+
+        self._ingress_timer = RepeatingTimer(
+            timer, self.config.PropagateBatchWait, self._flush_auth_queue,
+            active=False)
+        # tick-batched quorum mode for a standalone vote plane; a pool
+        # composition that shares a grouped plane drives ticks itself
+        self._quorum_tick_timer = None
+        if (drive_quorum_ticks and vote_plane is not None
+                and self.config.QuorumTickInterval > 0):
+            vote_plane.defer_flush_on_query = True
+            self._quorum_tick_timer = RepeatingTimer(
+                timer, self.config.QuorumTickInterval, self._quorum_tick,
+                active=False)
+        self.vote_plane = vote_plane
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.ordering.start()
+        self._ingress_timer.start()
+        if self._quorum_tick_timer is not None:
+            self._quorum_tick_timer.start()
+
+    def stop(self) -> None:
+        self.ordering.stop()
+        self._ingress_timer.stop()
+        if self._quorum_tick_timer is not None:
+            self._quorum_tick_timer.stop()
+
+    def _quorum_tick(self) -> None:
+        self.vote_plane.sync()
+        self.ordering.service_quorum_tick()
+        self.checkpoints.service_quorum_tick()
+
+    # ------------------------------------------------------------------
+    # client ingress
+    # ------------------------------------------------------------------
+
+    def submit_client_request(self, req: Request,
+                              client_id: Optional[str] = None) -> bool:
+        """Entry point a client transport calls. Returns False iff the
+        request was NACKed synchronously (replay); authentication itself is
+        asynchronous (device-batched on the ingress tick)."""
+        seen = self.req_idr_to_txn.get_by_payload_digest(req.payload_digest)
+        if seen is not None:
+            lid, seq = seen
+            self._to_client(client_id, RequestNack(
+                identifier=req.identifier, reqId=req.reqId,
+                reason=f"already processed: ledger {lid} seqNo {seq}"))
+            return False
+        if client_id is not None:
+            self._req_clients[req.digest] = client_id
+        self._auth_queue.append(req)
+        return True
+
+    def _enqueue_for_auth(self, req: Request) -> None:
+        """Relayed PROPAGATE whose request we haven't authenticated."""
+        self._auth_queue.append(req)
+
+    def _flush_auth_queue(self) -> None:
+        """ONE device batch authenticates everything queued this tick."""
+        if not self._auth_queue:
+            return
+        batch, self._auth_queue = self._auth_queue, []
+        verdicts = self.authnr.authenticate_batch(batch)
+        for req, ok in zip(batch, verdicts):
+            client = self._req_clients.get(req.digest)
+            if not ok:
+                state = self.propagator.requests.get(req.digest)
+                if state is not None:
+                    state.auth_pending = False
+                self._to_client(client, RequestNack(
+                    identifier=req.identifier, reqId=req.reqId,
+                    reason="signature verification failed"))
+                continue
+            self._to_client(client, RequestAck(
+                identifier=req.identifier, reqId=req.reqId))
+            self.propagator.propagate(req, sender_client=client)
+
+    def _to_client(self, client_id: Optional[str], msg) -> None:
+        if client_id is None:
+            return  # relayed request: no client of ours is waiting on it
+        self.client_outbox.append((client_id, msg))
+
+    # ------------------------------------------------------------------
+    # propagation -> ordering
+    # ------------------------------------------------------------------
+
+    def _on_request_finalised(self, request: Request) -> None:
+        self.requests_pool.enqueue(request)
+        self.ordering.on_request_finalised()
+
+    def _on_request_propagates(self, msg: RequestPropagates) -> None:
+        """Ordering saw a PRE-PREPARE referencing requests we lack: fetch
+        peers' PROPAGATEs (digest-authenticated on the way back)."""
+        for digest in msg.bad_requests:
+            self.internal_bus.send(MissingMessage(
+                msg_type="PROPAGATE", key=digest,
+                inst_id=self.data.inst_id, dst=None))
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _on_ordered(self, ordered: Ordered, *args) -> None:
+        self.requests_pool.mark_ordered(ordered.reqIdr)
+        if ordered.ppSeqNo <= self.executed_upto:
+            return  # already executed (re-ordered after view change)
+        self.executed_upto = ordered.ppSeqNo
+        self.ordered_log.append(ordered)
+        staged = self.executor.commit_batch(ordered.ppSeqNo)
+        if staged is None:
+            return
+        ledger = self.boot.db.get_ledger(staged.ledger_id)
+        valid = list(staged.batch.valid_digests)
+        first_seq = ledger.size - len(valid) + 1
+        for offset, digest in enumerate(valid):
+            seq_no = first_seq + offset
+            txn = ledger.get_by_seq_no(seq_no)
+            req = self.propagator.get(digest)
+            payload_digest = req.payload_digest if req is not None else digest
+            self.req_idr_to_txn.add(
+                digest, payload_digest, staged.ledger_id, seq_no)
+            reply = Reply(result=dict(
+                txn,
+                stateRootHash=ordered.stateRootHash,
+                txnRootHash=ordered.txnRootHash))
+            self.replies[digest] = reply
+            self._to_client(self._req_clients.pop(digest, None), reply)
+        self.propagator.gc(list(ordered.reqIdr))
+
+    def _on_catchup_finished(self, msg: CatchupFinished, *args) -> None:
+        self.executed_upto = max(self.executed_upto,
+                                 msg.last_caught_up_3pc[1])
+
+    # ------------------------------------------------------------------
+
+    @property
+    def ordered_digests(self) -> List[str]:
+        out: List[str] = []
+        for o in self.ordered_log:
+            out.extend(o.reqIdr)
+        return out
+
+    def get_nym_data(self, did: str) -> Optional[Dict[str, Any]]:
+        return self.boot.nym_handler.get_nym_data(did, is_committed=True)

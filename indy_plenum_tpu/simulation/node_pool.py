@@ -1,0 +1,117 @@
+"""Full-stack simulated pool: real Node composition roots on a sim network.
+
+Unlike :mod:`indy_plenum_tpu.simulation.pool` (which wires the consensus
+services directly and abstracts request dissemination into one shared
+pool), every validator here is a real :class:`~indy_plenum_tpu.server.node
+.Node`: client requests enter ONE node, get device-batch authenticated,
+spread via PROPAGATE to the f+1 finalisation quorum, order through 3PC,
+execute against real ledgers/SMT state, and produce client Replies. This
+is the integration surface for the Node/Propagator layer.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+from ..common.constants import TRUSTEE
+from ..common.request import Request
+from ..config import Config, getConfig
+from ..crypto.signers import DidSigner
+from ..ledger.genesis import genesis_nym_txn
+from ..server.node import Node
+from .mock_timer import MockTimer
+from .sim_network import SimNetwork
+
+
+class NodePool:
+    def __init__(self, n_nodes: int = 4, seed: int = 0,
+                 config: Optional[Config] = None,
+                 device_quorum: bool = False,
+                 bls: bool = False):
+        self.config = config or getConfig(
+            {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+             "PropagateBatchWait": 0.05})
+        self.timer = MockTimer(start_time=1_700_000_000.0)
+        self.network = SimNetwork(self.timer, seed=seed)
+        self.validators = [f"node{i}" for i in range(n_nodes)]
+
+        self.trustee = DidSigner(b"\x09" * 32)
+        domain_genesis = [genesis_nym_txn(
+            self.trustee.identifier, self.trustee.verkey, role=TRUSTEE)]
+        seed_keys = {self.trustee.identifier: self.trustee.verkey}
+
+        self.bls_keys = None
+        if bls:
+            from ..bls.factory import generate_bls_keys
+
+            self.bls_keys = {
+                name: generate_bls_keys(
+                    hashlib.sha256(b"sim-bls-" + name.encode()).digest())
+                for name in self.validators}
+
+        from .quorum_driver import drive_group_ticks, make_vote_group
+
+        self.vote_group = None
+        if device_quorum:
+            self.vote_group = make_vote_group(
+                n_nodes, self.validators, self.config)
+
+        self.nodes: List[Node] = []
+        for i, name in enumerate(self.validators):
+            plane = self.vote_group.view(i) if self.vote_group else None
+            node = Node(
+                name, self.validators, self.timer, self.network,
+                config=self.config, domain_genesis=domain_genesis,
+                seed_keys=dict(seed_keys), bls_keys=self.bls_keys,
+                vote_plane=plane,
+                drive_quorum_ticks=False)  # the pool drives group ticks
+            self.nodes.append(node)
+        self.network.connect_all()
+        for node in self.nodes:
+            node.start()
+
+        self._quorum_tick_timer = drive_group_ticks(
+            self.timer, self.config, self.vote_group, self.nodes)
+
+        self._req_seq = 0
+
+    # ------------------------------------------------------------------
+
+    def node(self, name: str) -> Node:
+        return next(n for n in self.nodes if n.name == name)
+
+    @property
+    def primary(self) -> Node:
+        return self.node(self.nodes[0].data.primaries[0])
+
+    def make_nym_request(self, seq: Optional[int] = None,
+                         signer: Optional[DidSigner] = None) -> Request:
+        """A signed NYM write creating a fresh target identity."""
+        from ..common.constants import NYM, TARGET_NYM, TXN_TYPE, VERKEY
+
+        if seq is None:
+            self._req_seq += 1
+            seq = self._req_seq
+        signer = signer or self.trustee
+        target = DidSigner(hashlib.sha256(
+            b"pool-target-%d" % seq).digest())
+        req = Request(
+            identifier=signer.identifier, reqId=seq,
+            operation={TXN_TYPE: NYM, TARGET_NYM: target.identifier,
+                       VERKEY: target.verkey})
+        signer.sign_request(req)
+        req.target_signer = target  # test convenience
+        return req
+
+    def submit_to(self, node_name: str, req: Request,
+                  client_id: str = "client1") -> bool:
+        """Client sends a request to exactly ONE node (the real topology)."""
+        return self.node(node_name).submit_client_request(req, client_id)
+
+    def run_for(self, seconds: float) -> None:
+        self.timer.advance(seconds)
+
+    def honest_nodes_agree(self) -> bool:
+        logs = [tuple(n.ordered_digests) for n in self.nodes]
+        shortest = min(len(l) for l in logs)
+        return all(l[:shortest] == logs[0][:shortest] for l in logs)

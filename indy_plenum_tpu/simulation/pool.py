@@ -374,14 +374,12 @@ class SimPool:
 
         # all nodes share ONE stacked device plane (member axis vmapped):
         # votes for the whole pool ride a single dispatch per flush
+        from .quorum_driver import drive_group_ticks, make_vote_group
+
         self.vote_group = None
         if device_quorum:
-            from ..tpu.vote_plane import VotePlaneGroup
-
-            self.vote_group = VotePlaneGroup(
-                n_nodes, self.validators, log_size=self.config.LOG_SIZE,
-                n_checkpoints=max(
-                    1, self.config.LOG_SIZE // self.config.CHK_FREQ))
+            self.vote_group = make_vote_group(
+                n_nodes, self.validators, self.config)
 
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
@@ -396,21 +394,8 @@ class SimPool:
         # tick-batched quorum mode: ONE group flush per tick serves the
         # whole pool; services evaluate against that snapshot and votes
         # recorded during the wave buffer for the next tick
-        self._quorum_tick_timer = None
-        if self.vote_group is not None and self.config.QuorumTickInterval > 0:
-            from ..common.timer import RepeatingTimer
-
-            for node in self.nodes:
-                node.vote_plane.defer_flush_on_query = True
-            self._quorum_tick_timer = RepeatingTimer(
-                self.timer, self.config.QuorumTickInterval,
-                self._pool_quorum_tick)
-
-    def _pool_quorum_tick(self) -> None:
-        self.vote_group.flush()
-        for node in self.nodes:
-            node.ordering.service_quorum_tick()
-            node.checkpoints.service_quorum_tick()
+        self._quorum_tick_timer = drive_group_ticks(
+            self.timer, self.config, self.vote_group, self.nodes)
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)

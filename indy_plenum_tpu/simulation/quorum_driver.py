@@ -1,0 +1,45 @@
+"""Shared device-quorum wiring for the simulation pools.
+
+Both :class:`~indy_plenum_tpu.simulation.pool.SimPool` (consensus services
+wired directly) and :class:`~indy_plenum_tpu.simulation.node_pool.NodePool`
+(full Node composition roots) share one grouped device vote plane and, in
+tick-batched mode, one pool-level tick that flushes the whole group once
+and then lets every node evaluate against the fresh snapshot.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.timer import RepeatingTimer, TimerService
+from ..config import Config
+
+
+def make_vote_group(n_nodes: int, validators, config: Config):
+    from ..tpu.vote_plane import VotePlaneGroup
+
+    return VotePlaneGroup(
+        n_nodes, list(validators), log_size=config.LOG_SIZE,
+        n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
+
+
+def drive_group_ticks(timer: TimerService, config: Config, vote_group,
+                      nodes) -> Optional[RepeatingTimer]:
+    """Start the pool-level quorum tick (tick-batched mode only).
+
+    Each node must expose ``vote_plane`` / ``ordering`` / ``checkpoints``;
+    queries between ticks read the per-tick snapshot
+    (``defer_flush_on_query``), and ONE group flush per tick serves the
+    whole pool.
+    """
+    if vote_group is None or config.QuorumTickInterval <= 0:
+        return None
+    for node in nodes:
+        node.vote_plane.defer_flush_on_query = True
+
+    def tick() -> None:
+        vote_group.flush()
+        for node in nodes:
+            node.ordering.service_quorum_tick()
+            node.checkpoints.service_quorum_tick()
+
+    return RepeatingTimer(timer, config.QuorumTickInterval, tick)

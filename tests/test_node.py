@@ -1,0 +1,143 @@
+"""Node composition root: ingress, propagation, replay protection, replies.
+
+VERDICT round-2 item 3: a real Node owning ingress (device-batched
+authentication per node), PROPAGATE with per-node f+1 digest finalisation
+replacing the shared-pool fiction, replay protection, and NYM-state-backed
+verkey resolution in CoreAuthNr.
+
+Reference behaviours: plenum/server/node.py (processRequest ->
+tryForwarding), plenum/server/propagator.py (f+1 finalisation),
+plenum/persistence/req_id_to_txn.py (replay detection).
+"""
+import pytest
+
+from indy_plenum_tpu.common.messages.node_messages import (
+    Propagate,
+    Reply,
+    RequestAck,
+    RequestNack,
+)
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.simulation.node_pool import NodePool
+from indy_plenum_tpu.simulation.sim_network import delay_message_types
+
+
+def all_ordered(pool, count):
+    return all(len(n.ordered_digests) == count for n in pool.nodes)
+
+
+def test_single_entry_node_request_orders_everywhere():
+    """A client talks to ONE node; f+1 PROPAGATE finalisation carries the
+    request to the whole pool and it orders + executes on every node."""
+    pool = NodePool(4, seed=1)
+    req = pool.make_nym_request()
+    assert pool.submit_to("node2", req)  # NOT the primary
+    pool.run_for(15)
+    assert all_ordered(pool, 1)
+    assert pool.honest_nodes_agree()
+    # executed: the NYM is readable from committed state on every node
+    for node in pool.nodes:
+        data = node.get_nym_data(req.operation["dest"])
+        assert data is not None and data["verkey"] == req.operation["verkey"]
+    # the entry node produced REQACK + REPLY for the client
+    entry = pool.node("node2")
+    kinds = [type(m) for _, m in entry.client_outbox]
+    assert RequestAck in kinds and Reply in kinds
+    reply = entry.replies[req.digest]
+    assert reply.result["txnMetadata"]["seqNo"] >= 1
+
+
+def test_propagation_finalises_on_node_that_missed_propagates():
+    """A node cut off from PROPAGATEs sees the PRE-PREPARE reference an
+    unknown request, fetches peers' PROPAGATEs (digest-authenticated) and
+    still orders — VERDICT item 3's 'missing request finalises' criterion."""
+    pool = NodePool(4, seed=2)
+    # node3 receives no PROPAGATE from anyone
+    undelay = pool.network.add_delayer(
+        delay_message_types(Propagate, to="node3"))
+    req = pool.make_nym_request()
+    pool.submit_to("node0", req)
+    pool.run_for(20)
+    undelay()
+    # consensus proceeded without node3's propagate vote (quorum is f+1=2);
+    # node3 fetched the request content and ordered the same log
+    assert all_ordered(pool, 1)
+    assert pool.honest_nodes_agree()
+    assert pool.node("node3").get_nym_data(req.operation["dest"]) is not None
+
+
+def test_replayed_request_is_rejected():
+    pool = NodePool(4, seed=3)
+    req = pool.make_nym_request()
+    pool.submit_to("node1", req)
+    pool.run_for(15)
+    assert all_ordered(pool, 1)
+
+    # same request again (same signature): synchronous NACK, nothing orders
+    assert pool.submit_to("node1", req) is False
+    nacks = [m for _, m in pool.node("node1").client_outbox
+             if isinstance(m, RequestNack)]
+    assert nacks and "already processed" in nacks[-1].reason
+    # replay to a DIFFERENT node is also rejected (index is per-node but
+    # fed identically by execution on every node)
+    assert pool.submit_to("node2", req) is False
+    pool.run_for(10)
+    assert all_ordered(pool, 1)
+
+
+def test_forged_signature_nacked_and_not_propagated():
+    pool = NodePool(4, seed=4)
+    req = pool.make_nym_request()
+    req.operation["evil"] = True  # signature no longer covers payload
+    pool.submit_to("node0", req)
+    pool.run_for(10)
+    assert all_ordered(pool, 0)
+    outbox = pool.node("node0").client_outbox
+    assert any(isinstance(m, RequestNack)
+               and "signature" in m.reason for _, m in outbox)
+    # the forged request never reached other nodes' propagators
+    assert pool.node("node2").propagator.requests.get(req.digest) is None
+
+
+def test_state_backed_verkey_resolution_end_to_end():
+    """The north-star e2e: a NYM txn writes a NEW identity's verkey into
+    domain state via consensus; that identity then signs a request which
+    authenticates purely from state (no seed_keys entry exists for it)."""
+    pool = NodePool(4, seed=5)
+    nym_req = pool.make_nym_request()
+    target = nym_req.target_signer
+    pool.submit_to("node0", nym_req)
+    pool.run_for(15)
+    assert all_ordered(pool, 1)
+
+    # the fresh identity is NOT in any node's seed keys
+    for node in pool.nodes:
+        assert target.identifier not in node.authnr._seed_keys
+
+    follow_up = pool.make_nym_request(signer=target)
+    pool.submit_to("node3", follow_up)
+    pool.run_for(15)
+    # NYM role rules: the new identity (no role) may create its own NYMs?
+    # NymHandler requires TRUSTEE for role-bearing writes only; a plain NYM
+    # write by a known identity is authenticated — the signature check is
+    # what this test pins. It must have been ACKed (auth passed via state).
+    entry = pool.node("node3")
+    acks = [m for _, m in entry.client_outbox if isinstance(m, RequestAck)]
+    assert acks, [m for _, m in entry.client_outbox]
+
+
+def test_device_quorum_node_pool_tick_mode():
+    """The full Node stack with the grouped device vote plane as sole
+    authority and tick-batched flushes (the bench configuration, now with
+    real ingress/propagation/execution)."""
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                        "PropagateBatchWait": 0.05,
+                        "QuorumTickInterval": 0.05})
+    pool = NodePool(4, seed=6, config=config, device_quorum=True)
+    reqs = [pool.make_nym_request() for _ in range(6)]
+    for i, req in enumerate(reqs):
+        pool.submit_to(f"node{i % 4}", req)
+    pool.run_for(30)
+    assert all_ordered(pool, 6)
+    assert pool.honest_nodes_agree()
+    assert pool.vote_group.flushes > 0

@@ -63,6 +63,10 @@ class ExternalBus:
     def subscribe(self, message_type: type, handler: Callable) -> None:
         self._handlers[message_type].append(handler)
 
+    def unsubscribe(self, message_type: type, handler: Callable) -> None:
+        if handler in self._handlers.get(message_type, []):
+            self._handlers[message_type].remove(handler)
+
     def send(self, message: Any, dst: str | list[str] | None = None) -> None:
         self._send_handler(message, dst)
 

@@ -77,6 +77,16 @@ class StashingRouter(Router):
     def _process_from_bus(self, message, *args) -> None:
         self.process(message, *args)
 
+    def unsubscribe_all(self) -> None:
+        """Detach from every bus and drop stashes (a torn-down replica's
+        handlers must stop firing on the shared external bus)."""
+        for bus in self._buses:
+            if hasattr(bus, "unsubscribe"):
+                for mtype in list(self._handlers):  # types WE subscribed
+                    bus.unsubscribe(mtype, self._process_from_bus)
+        self._handlers.clear()
+        self._queues.clear()
+
     def stash_size(self, reason: int | None = None) -> int:
         if reason is not None:
             return len(self._queues[reason])

@@ -34,6 +34,7 @@ class Config:
     ThroughputWindowSize: int = 15
     ThroughputMinCnt: int = 16
     LatencyWindowSize: int = 15
+    PerfCheckFreq: float = 10.0  # monitor degradation check cadence (s)
 
     # --- view change ------------------------------------------------------
     ToleratePrimaryDisconnection: float = 2.0  # seconds

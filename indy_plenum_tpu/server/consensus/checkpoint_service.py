@@ -113,6 +113,8 @@ class CheckpointService:
         self._try_stabilize(view_no, seq_no_end)
 
     def process_checkpoint(self, cp: Checkpoint, sender: str):
+        if getattr(cp, "instId", self._data.inst_id) != self._data.inst_id:
+            return DISCARD, "other instance"
         if cp.viewNo < self._data.view_no:
             return DISCARD, "old view"
         if cp.seqNoEnd <= self._data.stable_checkpoint:

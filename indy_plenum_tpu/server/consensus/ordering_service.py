@@ -364,6 +364,10 @@ class OrderingService:
 
     def _common_checks(self, msg, key: Tuple[int, int]):
         """Shared view/watermark admission checks; verdict or None=pass."""
+        # multi-instance: every replica's services share the node's external
+        # bus; messages of other protocol instances are not ours to handle
+        if getattr(msg, "instId", self._data.inst_id) != self._data.inst_id:
+            return DISCARD, "other instance"
         view_no, pp_seq_no = key
         if view_no < self._data.view_no:
             return DISCARD, "old view"

@@ -1,0 +1,154 @@
+"""The R in RBFT: compare the master instance against the backups.
+
+Reference: plenum/server/monitor.py (`Monitor`). Every protocol instance
+orders the same client requests under a different primary; the monitor
+measures per-instance throughput (and master-vs-backup request latency)
+and, when the master's ratio drops below Delta — a slow-but-alive
+byzantine (or just slow) master primary — votes for a view change so a
+backup's primary takes over. Crash faults are caught by the primary
+connection monitor; THIS is what catches a primary that stays alive but
+throttles the pool.
+
+Checks (reference Monitor.isMasterDegraded):
+- throughput: master_tp / avg(backup_tps) < DELTA
+- latency: avg master latency - avg backup latency > OMEGA  (per-request
+  durations from finalisation to ordering)
+Both sides must be warmed up (ThroughputMinCnt events) before judging.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..common.event_bus import InternalBus
+from ..common.messages.internal_messages import VoteForViewChange
+from ..common.timer import RepeatingTimer, TimerService
+from .suspicion_codes import Suspicions
+from .throughput_measurement import (
+    LatencyMeasurement,
+    WindowedThroughputMeasurement,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def __init__(self,
+                 name: str,
+                 timer: TimerService,
+                 bus: InternalBus,
+                 config,
+                 num_instances: int):
+        self._name = name
+        self._timer = timer
+        self._bus = bus
+        self._config = config
+        self._throughputs: List[WindowedThroughputMeasurement] = []
+        self._latencies: List[LatencyMeasurement] = []
+        self.reset(num_instances)
+        # digest -> finalisation timestamp (latency measurement base)
+        self._finalised_at: Dict[str, float] = {}
+        self.degradation_votes = 0  # observability / tests
+
+        self._check_timer = RepeatingTimer(
+            timer, config.PerfCheckFreq, self.service_check, active=False)
+
+    def start(self) -> None:
+        self._check_timer.start()
+
+    def stop(self) -> None:
+        self._check_timer.stop()
+
+    # ------------------------------------------------------------------
+    # feeding
+    # ------------------------------------------------------------------
+
+    def reset(self, num_instances: Optional[int] = None) -> None:
+        """View change / instance count change: all measurements restart
+        (the old master's stats must not taint the new one)."""
+        if num_instances is None:
+            num_instances = len(self._throughputs)
+        now = self._timer.get_current_time()
+        self._throughputs = [
+            WindowedThroughputMeasurement(
+                window_size=self._config.ThroughputWindowSize,
+                min_cnt=self._config.ThroughputMinCnt,
+                first_ts=now)
+            for _ in range(num_instances)]
+        self._latencies = [
+            LatencyMeasurement(self._config.LatencyWindowSize)
+            for _ in range(num_instances)]
+        # latency bases from before the reset are meaningless against the
+        # new measurements (and would otherwise leak across view changes)
+        if hasattr(self, "_finalised_at"):
+            self._finalised_at.clear()
+
+    def request_finalised(self, digest: str) -> None:
+        self._finalised_at.setdefault(
+            digest, self._timer.get_current_time())
+
+    def requests_ordered(self, inst_id: int, digests: List[str]) -> None:
+        if inst_id >= len(self._throughputs):
+            return
+        now = self._timer.get_current_time()
+        self._throughputs[inst_id].add_request(now, count=len(digests))
+        lat = self._latencies[inst_id]
+        for d in digests:
+            t0 = self._finalised_at.get(d)
+            if t0 is not None:
+                lat.add_duration(now - t0)
+        if inst_id == 0:  # master ordered: the latency base is consumed
+            for d in digests:
+                self._finalised_at.pop(d, None)
+
+    # ------------------------------------------------------------------
+    # judging
+    # ------------------------------------------------------------------
+
+    def master_throughput_ratio(self) -> Optional[float]:
+        if len(self._throughputs) < 2:
+            return None
+        now = self._timer.get_current_time()
+        master = self._throughputs[0].get_throughput(now)
+        backups = [t.get_throughput(now) for t in self._throughputs[1:]]
+        backups = [b for b in backups if b is not None]
+        if not backups:
+            return None
+        avg = sum(backups) / len(backups)
+        if avg == 0:
+            return None
+        if master is None:
+            master = 0.0  # backups warmed up, master ordered ~nothing
+        return master / avg
+
+    def is_master_degraded(self) -> bool:
+        ratio = self.master_throughput_ratio()
+        if ratio is not None and ratio < self._config.DELTA:
+            return True
+        return self._is_master_latency_high()
+
+    def _is_master_latency_high(self) -> bool:
+        if len(self._latencies) < 2:
+            return False
+        master = self._latencies[0].get_avg_latency()
+        backups = [l.get_avg_latency() for l in self._latencies[1:]]
+        backups = [b for b in backups if b is not None]
+        if master is None or not backups:
+            return False
+        return master - (sum(backups) / len(backups)) > self._config.OMEGA
+
+    def service_check(self) -> None:
+        # prune latency bases the master never consumed (e.g. batches that
+        # executed via catchup emit no Ordered) — bounded memory
+        now = self._timer.get_current_time()
+        ttl = self._config.INSTANCE_CHANGE_TIMEOUT
+        stale = [d for d, t in self._finalised_at.items() if now - t > ttl]
+        for d in stale:
+            del self._finalised_at[d]
+        if self.is_master_degraded():
+            self.degradation_votes += 1
+            ratio = self.master_throughput_ratio()
+            logger.info("%s master degraded (ratio=%s) -> vote view change",
+                        self._name, ratio)
+            self._bus.send(VoteForViewChange(
+                view_no=None, suspicion=Suspicions.PRIMARY_DEGRADED))

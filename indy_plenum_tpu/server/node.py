@@ -64,27 +64,38 @@ logger = logging.getLogger(__name__)
 
 
 class NodeRequestsPool(RequestsPool):
-    """Per-node finalised-request queues, backed by this node's Propagator
-    (replaces the simulation's shared-pool fiction)."""
+    """Per-INSTANCE finalised-request queues (replaces the simulation's
+    shared-pool fiction). Requests are pinned here until this instance
+    orders them: the master may execute and GC the propagator's copy while
+    a backup instance is still ordering the same request independently."""
 
-    def __init__(self, propagator: Propagator, classify):
+    def __init__(self, propagator: Propagator, classify,
+                 bound: Optional[int] = None):
         self._propagator = propagator
         self._classify = classify  # Request -> ledger_id
+        self._bound = bound  # drop-oldest cap (backup instances)
         self._queues: Dict[int, List[str]] = {}
+        self._by_digest: Dict[str, Request] = {}
 
     def enqueue(self, request: Request) -> None:
         lid = self._classify(request)
         if lid is None:
             lid = DOMAIN_LEDGER_ID
-        self._queues.setdefault(lid, []).append(request.digest)
+        q = self._queues.setdefault(lid, [])
+        q.append(request.digest)
+        self._by_digest[request.digest] = request
+        if self._bound is not None and len(q) > self._bound:
+            dropped = q.pop(0)
+            self._by_digest.pop(dropped, None)
 
     def pop_ready(self, ledger_id: int, max_count: int) -> List[Request]:
         q = self._queues.get(ledger_id, [])
         take, self._queues[ledger_id] = q[:max_count], q[max_count:]
-        return [self._propagator.get(d) for d in take]
+        return [self._by_digest.get(d) or self._propagator.get(d)
+                for d in take]
 
     def get(self, digest: str) -> Optional[Request]:
-        return self._propagator.get(digest)
+        return self._by_digest.get(digest) or self._propagator.get(digest)
 
     def has_ready(self, ledger_id: int) -> bool:
         return bool(self._queues.get(ledger_id))
@@ -96,6 +107,8 @@ class NodeRequestsPool(RequestsPool):
         gone = set(digests)
         for lid, q in self._queues.items():
             self._queues[lid] = [d for d in q if d not in gone]
+        for d in gone:
+            self._by_digest.pop(d, None)
 
 
 class Node:
@@ -113,15 +126,21 @@ class Node:
                  seed_keys: Optional[Dict[str, str]] = None,
                  bls_keys=None,
                  vote_plane=None,
-                 drive_quorum_ticks: bool = True):
+                 drive_quorum_ticks: bool = True,
+                 num_instances: int = 1):
         self.name = name
         self.config = config or getConfig()
         self.timer = timer
+        # f+1 protocol instances (RBFT): instance i's primary is offset i
+        # in the round-robin; only the master (inst 0) executes
+        if num_instances <= 0:
+            num_instances = self.config.replicas_count(len(validators))
+        self.num_instances = num_instances
         self.data = ConsensusSharedData(
             name, validators, inst_id=0, is_master=True,
             log_size=self.config.LOG_SIZE)
         selector = RoundRobinConstantNodesPrimariesSelector(validators)
-        self.data.primaries = selector.select_primaries(0, 1)
+        self.data.primaries = selector.select_primaries(0, num_instances)
 
         self.internal_bus = InternalBus()
         self.external_bus = network.create_peer(name)
@@ -225,6 +244,34 @@ class Node:
             network=self.external_bus, timer=timer, bootstrap=self.boot,
             config=self.config, suspicion_sink=catchup_suspicion)
 
+        # --- RBFT: monitor + backup instances ----------------------------
+        from ..common.messages.internal_messages import (
+            ViewChangeFinished,
+            ViewChangeStarted,
+        )
+        from .monitor import Monitor
+        from .replicas import Replicas
+
+        self.monitor = Monitor(name, timer, self.internal_bus, self.config,
+                               num_instances=num_instances)
+        # backup pools are bounded drop-oldest: a stalled backup primary
+        # must read as a SLOW instance, not as unbounded node memory
+        self.replicas = Replicas(
+            name, validators, timer, self.external_bus, self.config,
+            make_requests_pool=lambda: NodeRequestsPool(
+                self.propagator,
+                classify=self.boot.write_manager.ledger_id_for_request,
+                bound=10 * self.config.LOG_SIZE),
+            on_backup_ordered=self._on_backup_ordered,
+            forward_request_propagates=self._on_request_propagates,
+            num_instances=num_instances)
+        if num_instances > 1:
+            self.replicas.build(0, self.data.primaries)
+        self.internal_bus.subscribe(ViewChangeStarted,
+                                    self._on_view_change_started)
+        self.internal_bus.subscribe(ViewChangeFinished,
+                                    self._on_view_change_finished)
+
         # --- execution + client replies ---------------------------------
         self.ordered_log: List[Ordered] = []
         self.executed_upto = self.executor.committed_seq()
@@ -253,12 +300,18 @@ class Node:
     def start(self) -> None:
         self.ordering.start()
         self._ingress_timer.start()
+        if self.num_instances > 1:
+            if not self.replicas.backups:  # restart after stop()
+                self.replicas.build(self.data.view_no, self.data.primaries)
+            self.monitor.start()
         if self._quorum_tick_timer is not None:
             self._quorum_tick_timer.start()
 
     def stop(self) -> None:
         self.ordering.stop()
         self._ingress_timer.stop()
+        self.monitor.stop()
+        self.replicas.teardown()
         if self._quorum_tick_timer is not None:
             self._quorum_tick_timer.stop()
 
@@ -324,6 +377,20 @@ class Node:
     def _on_request_finalised(self, request: Request) -> None:
         self.requests_pool.enqueue(request)
         self.ordering.on_request_finalised()
+        self.monitor.request_finalised(request.digest)
+        self.replicas.enqueue_finalised(request)
+
+    def _on_backup_ordered(self, inst_id: int, ordered: Ordered) -> None:
+        self.monitor.requests_ordered(inst_id, list(ordered.reqIdr))
+
+    def _on_view_change_started(self, msg, *args) -> None:
+        # backups' votes are void in the new view; they rebuild at finish
+        self.replicas.teardown()
+
+    def _on_view_change_finished(self, msg, *args) -> None:
+        self.monitor.reset(self.num_instances)
+        if self.num_instances > 1:
+            self.replicas.build(msg.view_no, self.data.primaries)
 
     def _on_request_propagates(self, msg: RequestPropagates) -> None:
         """Ordering saw a PRE-PREPARE referencing requests we lack: fetch
@@ -339,6 +406,7 @@ class Node:
 
     def _on_ordered(self, ordered: Ordered, *args) -> None:
         self.requests_pool.mark_ordered(ordered.reqIdr)
+        self.monitor.requests_ordered(0, list(ordered.reqIdr))
         if ordered.ppSeqNo <= self.executed_upto:
             return  # already executed (re-ordered after view change)
         self.executed_upto = ordered.ppSeqNo

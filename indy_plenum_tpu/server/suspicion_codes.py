@@ -39,6 +39,8 @@ class Suspicions:
     NEW_VIEW_CHECKPOINT_WRONG = Suspicion(
         23, "NEW_VIEW checkpoint not supported by view-change quorum")
     CHK_DIGEST_WRONG = Suspicion(24, "CHECKPOINT digest mismatch at stable")
+    PRIMARY_DEGRADED = Suspicion(
+        25, "master primary degraded (throughput/latency vs backups)")
     SEQ_NO_OLD = Suspicion(30, "3PC message below watermark")
     SEQ_NO_FUTURE = Suspicion(31, "3PC message above watermark")
     CATCHUP_REP_WRONG = Suspicion(40, "CATCHUP_REP txns fail audit proof")

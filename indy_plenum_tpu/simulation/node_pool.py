@@ -27,7 +27,9 @@ class NodePool:
     def __init__(self, n_nodes: int = 4, seed: int = 0,
                  config: Optional[Config] = None,
                  device_quorum: bool = False,
-                 bls: bool = False):
+                 bls: bool = False,
+                 num_instances: int = 1):
+        # num_instances: 1 = master only; 0 = auto f+1 (full RBFT)
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
              "PropagateBatchWait": 0.05})
@@ -63,7 +65,7 @@ class NodePool:
                 name, self.validators, self.timer, self.network,
                 config=self.config, domain_genesis=domain_genesis,
                 seed_keys=dict(seed_keys), bls_keys=self.bls_keys,
-                vote_plane=plane,
+                vote_plane=plane, num_instances=num_instances,
                 drive_quorum_ticks=False)  # the pool drives group ticks
             self.nodes.append(node)
         self.network.connect_all()

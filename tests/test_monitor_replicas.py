@@ -1,0 +1,125 @@
+"""RBFT monitor + backup instances (VERDICT round-2 item 4).
+
+Reference: plenum/server/monitor.py (Delta degradation), plenum/server/
+replicas.py (f+1 parallel instances), plenum/server/
+throughput_measurement.py. The defining RBFT property: a master primary
+that stays ALIVE but throttles ordering is deposed because some backup
+instance (different primary) keeps ordering the same requests at full
+speed and the Delta ratio exposes the master.
+"""
+from indy_plenum_tpu.common.messages.node_messages import PrePrepare
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.server.throughput_measurement import (
+    WindowedThroughputMeasurement,
+)
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+
+def test_windowed_throughput_warmup_and_rate():
+    m = WindowedThroughputMeasurement(window_size=5.0, lookback_windows=4,
+                                      min_cnt=10, first_ts=0.0)
+    assert m.get_throughput(1.0) is None  # not warmed up
+    for i in range(20):
+        m.add_request(float(i))  # 1/sec over 20s
+    tp = m.get_throughput(21.0)
+    assert tp is not None and 0.5 < tp < 1.5
+
+
+def test_backups_order_in_parallel_with_master():
+    """Both instances order the same requests under different primaries."""
+    pool = NodePool(4, seed=11, num_instances=0)  # auto f+1 = 2
+    assert all(len(n.replicas.backups) == 1 for n in pool.nodes)
+    # inst 0 primary is node0, inst 1 primary is node1 (round robin)
+    node = pool.nodes[2]
+    assert node.data.primaries[0] == "node0"
+    assert node.replicas.backups[0].data.primaries[1] == "node1"
+
+    for _ in range(4):
+        pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(20)
+    for n in pool.nodes:
+        assert len(n.ordered_digests) == 4, n.name  # master executed
+        backup = n.replicas.backups[0]  # backups order but never execute
+        assert backup.data.last_ordered_3pc[1] >= 1, \
+            (n.name, backup.data.last_ordered_3pc)
+    # monitor saw both instances move
+    ratio = pool.nodes[2].monitor.master_throughput_ratio()
+    # with few requests both may be un-warmed; the ratio just must not
+    # report the master degraded
+    assert ratio is None or ratio >= 0.5
+
+
+def test_throttled_master_primary_is_voted_out():
+    """The R in RBFT: master primary alive but slow -> INSTANCE_CHANGE
+    quorum -> view change -> the next primary takes over and throughput
+    recovers."""
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+        "PropagateBatchWait": 0.05,
+        "ThroughputWindowSize": 2, "ThroughputMinCnt": 4,
+        "PerfCheckFreq": 2.0, "DELTA": 0.4,
+        # the throttled master must not trip the disconnect detector —
+        # this test is specifically about the ALIVE-but-slow case
+        "ToleratePrimaryDisconnection": 10_000.0,
+        "NewViewTimeout": 10_000.0,
+    })
+    pool = NodePool(4, seed=12, config=config, num_instances=0)
+    master_primary = pool.nodes[0].data.primaries[0]
+    assert master_primary == "node0"
+
+    # throttle ONLY the master instance's PRE-PREPAREs from node0: the
+    # primary stays connected and keeps answering everything else
+    def throttle(msg, frm, to):
+        if isinstance(msg, PrePrepare) and frm == master_primary \
+                and msg.instId == 0:
+            return 60.0
+        return None
+
+    pool.network.add_delayer(throttle)
+
+    for i in range(16):
+        pool.submit_to(f"node{i % 4}", pool.make_nym_request())
+    pool.run_for(60)
+
+    # the pool moved to a new view with a different master primary...
+    for n in pool.nodes:
+        assert n.data.view_no >= 1, (n.name, n.data.view_no)
+    new_primary = pool.nodes[1].data.primaries[0]
+    assert new_primary != master_primary
+    # ...because monitors actually voted degradation
+    assert any(n.monitor.degradation_votes > 0 for n in pool.nodes)
+
+    # and the pool is live again under the new primary: everything orders
+    pool.run_for(40)
+    counts = [len(n.ordered_digests) for n in pool.nodes]
+    assert min(counts) == 16, counts
+    assert pool.honest_nodes_agree()
+
+
+def test_backups_rebuilt_after_view_change():
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+        "PropagateBatchWait": 0.05,
+        "ThroughputWindowSize": 2, "ThroughputMinCnt": 4,
+        "PerfCheckFreq": 2.0,
+        "ToleratePrimaryDisconnection": 10_000.0,
+        "NewViewTimeout": 10_000.0,
+    })
+    pool = NodePool(4, seed=13, config=config, num_instances=0)
+
+    def throttle(msg, frm, to):
+        if isinstance(msg, PrePrepare) and frm == "node0" \
+                and msg.instId == 0:
+            return 60.0
+        return None
+
+    pool.network.add_delayer(throttle)
+    for i in range(12):
+        pool.submit_to(f"node{i % 4}", pool.make_nym_request())
+    pool.run_for(60)
+    for n in pool.nodes:
+        assert n.data.view_no >= 1
+        backup = n.replicas.backups[0]
+        # rebuilt for the new view with the new primaries
+        assert backup.data.view_no == n.data.view_no
+        assert backup.data.primaries == n.data.primaries

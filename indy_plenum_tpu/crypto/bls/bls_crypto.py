@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ...utils.base58 import b58decode, b58encode
 from . import bn254 as bn
+from . import bn254_fast as fast
 
 # --- point serialization (wire: base58 of fixed-width big-endian) ---------
 
@@ -93,7 +94,7 @@ class BlsKeyPair:
             raise ValueError("seed must be 32 bytes")
         self.sk = int.from_bytes(
             hashlib.sha512(b"bls-bn254-sk" + seed).digest(), "big") % bn.R
-        self.pk: bn.G2Point = bn.g2_mul(bn.G2_GEN, self.sk)
+        self.pk: bn.G2Point = fast.g2_mul(bn.G2_GEN, self.sk)
 
     @property
     def pk_b58(self) -> str:
@@ -102,7 +103,7 @@ class BlsKeyPair:
     def pop(self) -> str:
         """Proof of possession: BLS sig over the serialized pubkey."""
         return b58encode(g1_to_bytes(
-            bn.g1_mul(hash_to_g1(g2_to_bytes(self.pk)), self.sk)))
+            fast.g1_mul(hash_to_g1(g2_to_bytes(self.pk)), self.sk)))
 
 
 class BlsCryptoSigner:
@@ -116,7 +117,7 @@ class BlsCryptoSigner:
         return self._kp.pk_b58
 
     def sign(self, message: bytes) -> str:
-        sig = bn.g1_mul(hash_to_g1(message), self._kp.sk)
+        sig = fast.g1_mul(hash_to_g1(message), self._kp.sk)
         return b58encode(g1_to_bytes(sig))
 
 
@@ -135,7 +136,7 @@ def _g2_checked(pk_b58: str) -> Optional[bn.G2Point]:
     if pk is None:
         return None
     if ok is None:
-        ok = bn.g2_in_subgroup(pk)
+        ok = fast.g2_in_subgroup(pk)
         if len(_SUBGROUP_CACHE) > 4096:
             _SUBGROUP_CACHE.clear()
         _SUBGROUP_CACHE[pk_b58] = ok
@@ -155,7 +156,7 @@ class BlsCryptoVerifier:
         if sig is None or pk is None:
             return False
         # e(H(m), pk) == e(sig, G2) <=> e(H(m), pk) * e(-sig, G2) == 1
-        return bn.pairing_check([
+        return fast.pairing_check([
             (hash_to_g1(message), pk),
             (bn.g1_neg(sig), bn.G2_GEN),
         ])
@@ -171,9 +172,8 @@ class BlsCryptoVerifier:
 
     @staticmethod
     def aggregate_sigs(signatures_b58: Sequence[str]) -> str:
-        acc: bn.G1Point = None
-        for s in signatures_b58:
-            acc = bn.g1_add(acc, g1_from_bytes(b58decode(s)))
+        acc = fast.g1_sum(
+            g1_from_bytes(b58decode(s)) for s in signatures_b58)
         return b58encode(g1_to_bytes(acc))
 
     @staticmethod
@@ -183,15 +183,16 @@ class BlsCryptoVerifier:
             sig = g1_from_bytes(b58decode(signature_b58))
         except ValueError:
             return False
-        acc: bn.G2Point = None
+        pts = []
         for pk in pks_b58:
             p = _g2_checked(pk)
             if p is None:
                 return False
-            acc = bn.g2_add(acc, p)
+            pts.append(p)
+        acc = fast.g2_sum(pts)
         if sig is None or acc is None:
             return False
-        return bn.pairing_check([
+        return fast.pairing_check([
             (hash_to_g1(message), acc),
             (bn.g1_neg(sig), bn.G2_GEN),
         ])

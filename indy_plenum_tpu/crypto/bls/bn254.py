@@ -331,8 +331,25 @@ def g2_mul(a: G2Point, k: int) -> G2Point:
 
 
 def g2_in_subgroup(pt: G2Point) -> bool:
-    """Full-order check: r*Q == O (G2's cofactor is > 1)."""
-    return g2_is_on_curve(pt) and g2_mul(pt, R) is None
+    """Full-order check: r*Q == O (G2's cofactor is > 1).
+
+    The ladder must NOT reduce the scalar mod R the way g2_mul does —
+    [R mod R]Q = O for every point, which would make this check vacuous
+    and admit out-of-subgroup keys (small-subgroup confinement on the
+    twist, whose order is R*(2P - R))."""
+    if pt is None:
+        return True
+    if not g2_is_on_curve(pt):
+        return False
+    out: G2Point = None
+    add = pt
+    k = R
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_add(add, add)
+        k >>= 1
+    return out is None
 
 
 # --- pairing ---------------------------------------------------------------

@@ -1,0 +1,379 @@
+"""Fast BN254 path: projective coordinates, inversion-free Miller loop.
+
+:mod:`indy_plenum_tpu.crypto.bls.bn254` is the correctness oracle — affine
+arithmetic, untwist-to-Fp12 Miller loop, one Fp inversion per point op.
+This module is the production path the oracle pins in tests/test_bls.py:
+
+- G1/G2 scalar multiplication in Jacobian coordinates (ONE inversion per
+  scalar mul instead of one per bit: 24 ms -> ~0.5 ms for a G1 sign);
+- the optimal-ate Miller loop on the twist in homogeneous fractional
+  coordinates (x = X/Z, y = Y/Z over Fp2) with denominator-free line
+  evaluation — line values are scaled by Fp2 subfield factors, which the
+  final exponentiation kills (c^((p^12-1)/r) = 1 for c in Fp2 because
+  (p^2-1) | (p^6-1) divides the easy part);
+- sparse 0-1-3 line accumulation: a line evaluates to
+  c0 + c1*w + c3*w^3, so the Fp12 product touches ~15 Fp2 muls instead of
+  a dense mul.
+
+Formulas are derived directly from the affine chord/tangent equations
+(docstrings show the derivation), NOT transcribed from any library; the
+oracle equivalence tests are the safety net for the embedding layout.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from . import bn254 as bn
+from .bn254 import (
+    FP12_ONE,
+    FP2_ZERO,
+    P,
+    f2_add,
+    f2_inv,
+    f2_mul,
+    f2_muls,
+    f2_neg,
+    f2_sqr,
+    f2_sub,
+    f6_add,
+    f6_mul_v,
+    f6_sub,
+    f12_mul,
+    f12_sqr,
+)
+
+Fp2 = Tuple[int, int]
+_INV2 = pow(2, P - 2, P)
+
+# twist curve constant b' = 3/xi  (E': y^2 = x^3 + b')
+_B_TWIST = f2_mul((3, 0), f2_inv(bn.XI))
+
+
+# ---------------------------------------------------------------------------
+# G1 Jacobian (x = X/Z^2, y = Y/Z^3), curve y^2 = x^3 + 3
+# ---------------------------------------------------------------------------
+
+
+def _g1j_double(X: int, Y: int, Z: int):
+    if Y == 0:
+        return 0, 1, 0  # infinity
+    S = 4 * X * Y * Y % P
+    M = 3 * X * X % P
+    X3 = (M * M - 2 * S) % P
+    Y8 = 8 * pow(Y, 4, P) % P
+    Y3 = (M * (S - X3) - Y8) % P
+    Z3 = 2 * Y * Z % P
+    return X3, Y3, Z3
+
+
+def _g1j_add_affine(X: int, Y: int, Z: int, x2: int, y2: int):
+    if Z == 0:
+        return x2, y2, 1
+    Z2 = Z * Z % P
+    U2 = x2 * Z2 % P
+    S2 = y2 * Z2 * Z % P
+    H = (U2 - X) % P
+    r = (S2 - Y) % P
+    if H == 0:
+        if r == 0:
+            return _g1j_double(X, Y, Z)
+        return 0, 1, 0
+    H2 = H * H % P
+    H3 = H * H2 % P
+    XH2 = X * H2 % P
+    X3 = (r * r - H3 - 2 * XH2) % P
+    Y3 = (r * (XH2 - X3) - Y * H3) % P
+    Z3 = Z * H % P
+    return X3, Y3, Z3
+
+
+def g1_mul(pt: bn.G1Point, k: int) -> bn.G1Point:
+    """Jacobian double-and-add; one field inversion total."""
+    k %= bn.R
+    if pt is None or k == 0:
+        return None
+    x2, y2 = pt
+    X, Y, Z = 0, 1, 0
+    for bit in bin(k)[2:]:
+        X, Y, Z = _g1j_double(X, Y, Z)
+        if bit == "1":
+            X, Y, Z = _g1j_add_affine(X, Y, Z, x2, y2)
+    if Z == 0:
+        return None
+    zi = pow(Z, P - 2, P)
+    zi2 = zi * zi % P
+    return (X * zi2 % P, Y * zi2 * zi % P)
+
+
+# ---------------------------------------------------------------------------
+# G2 Jacobian over Fp2 (same formulas, field ops from the oracle)
+# ---------------------------------------------------------------------------
+
+_F2_0: Fp2 = (0, 0)
+_F2_1: Fp2 = (1, 0)
+
+
+def _g2j_double(X: Fp2, Y: Fp2, Z: Fp2):
+    if Y == _F2_0:
+        return _F2_0, _F2_1, _F2_0
+    Y2 = f2_sqr(Y)
+    S = f2_muls(f2_mul(X, Y2), 4)
+    M = f2_muls(f2_sqr(X), 3)
+    X3 = f2_sub(f2_sqr(M), f2_muls(S, 2))
+    Y3 = f2_sub(f2_mul(M, f2_sub(S, X3)), f2_muls(f2_sqr(Y2), 8))
+    Z3 = f2_muls(f2_mul(Y, Z), 2)
+    return X3, Y3, Z3
+
+
+def _g2j_add_affine(X: Fp2, Y: Fp2, Z: Fp2, x2: Fp2, y2: Fp2):
+    if Z == _F2_0:
+        return x2, y2, _F2_1
+    Z2 = f2_sqr(Z)
+    U2 = f2_mul(x2, Z2)
+    S2 = f2_mul(f2_mul(y2, Z2), Z)
+    H = f2_sub(U2, X)
+    r = f2_sub(S2, Y)
+    if H == _F2_0:
+        if r == _F2_0:
+            return _g2j_double(X, Y, Z)
+        return _F2_0, _F2_1, _F2_0
+    H2 = f2_sqr(H)
+    H3 = f2_mul(H, H2)
+    XH2 = f2_mul(X, H2)
+    X3 = f2_sub(f2_sub(f2_sqr(r), H3), f2_muls(XH2, 2))
+    Y3 = f2_sub(f2_mul(r, f2_sub(XH2, X3)), f2_mul(Y, H3))
+    Z3 = f2_mul(Z, H)
+    return X3, Y3, Z3
+
+
+def g2_mul(pt: bn.G2Point, k: int) -> bn.G2Point:
+    k %= bn.R
+    if pt is None or k == 0:
+        return None
+    x2, y2 = pt
+    X, Y, Z = _F2_0, _F2_1, _F2_0
+    for bit in bin(k)[2:]:
+        X, Y, Z = _g2j_double(X, Y, Z)
+        if bit == "1":
+            X, Y, Z = _g2j_add_affine(X, Y, Z, x2, y2)
+    if Z == _F2_0:
+        return None
+    zi = f2_inv(Z)
+    zi2 = f2_sqr(zi)
+    return (f2_mul(X, zi2), f2_mul(Y, f2_mul(zi2, zi)))
+
+
+def g2_in_subgroup(pt: bn.G2Point) -> bool:
+    """[R]Q == O via an UNREDUCED Jacobian ladder — g2_mul reduces the
+    scalar mod R, which would turn this check into a tautology and admit
+    out-of-subgroup keys (the twist's order is R*(2P - R))."""
+    if pt is None:
+        return True
+    if not bn.g2_is_on_curve(pt):
+        return False
+    x2, y2 = pt
+    X, Y, Z = _F2_0, _F2_1, _F2_0
+    for bit in bin(bn.R)[2:]:
+        X, Y, Z = _g2j_double(X, Y, Z)
+        if bit == "1":
+            X, Y, Z = _g2j_add_affine(X, Y, Z, x2, y2)
+    return Z == _F2_0
+
+
+def g1_sum(points) -> bn.G1Point:
+    """Sum many G1 points with ONE inversion (Jacobian accumulation)."""
+    X, Y, Z = 0, 1, 0
+    for pt in points:
+        if pt is None:
+            continue
+        X, Y, Z = _g1j_add_affine(X, Y, Z, pt[0], pt[1])
+    if Z == 0:
+        return None
+    zi = pow(Z, P - 2, P)
+    zi2 = zi * zi % P
+    return (X * zi2 % P, Y * zi2 * zi % P)
+
+
+def g2_sum(points) -> bn.G2Point:
+    X, Y, Z = _F2_0, _F2_1, _F2_0
+    for pt in points:
+        if pt is None:
+            continue
+        X, Y, Z = _g2j_add_affine(X, Y, Z, pt[0], pt[1])
+    if Z == _F2_0:
+        return None
+    zi = f2_inv(Z)
+    zi2 = f2_sqr(zi)
+    return (f2_mul(X, zi2), f2_mul(Y, f2_mul(zi2, zi)))
+
+
+# ---------------------------------------------------------------------------
+# Miller loop on the twist: fractional coords x=X/Z, y=Y/Z over Fp2.
+#
+# A line through the UNTWISTED points evaluated at P=(xp, yp) has the shape
+# c0 + c1*w + c3*w^3 with c_i in Fp2 (derivation in each step function);
+# w^2 = v places c1 at Fp12 position (1,0) and c3 at (1,1).
+# ---------------------------------------------------------------------------
+
+
+def _sparse_013(f, c0: Fp2, c1: Fp2, c3: Fp2):
+    """f * (c0 + c1*w + c3*w^3), exploiting the zero coefficients.
+
+    With f = (a, b), l = (la, lb), la = (c0,0,0), lb = (c1,c3,0):
+    f*l = (a*la + v*(b*lb), (a+b)(la+lb) - a*la - b*lb)  [oracle f12_mul].
+    a*la is a scalar Fp2 product; b*lb and the cross term hit the sparse
+    (e0, e1, 0) pattern: (b0,b1,b2)*(e0,e1,0) =
+      (b0e0 + XI*b2e1, b0e1 + b1e0, b1e1 + b2e0).
+    """
+    a, b = f
+    t0 = (f2_mul(a[0], c0), f2_mul(a[1], c0), f2_mul(a[2], c0))
+
+    def sparse6(x, e0, e1):
+        x0, x1, x2 = x
+        return (f2_add(f2_mul(x0, e0), bn._mul_xi(f2_mul(x2, e1))),
+                f2_add(f2_mul(x0, e1), f2_mul(x1, e0)),
+                f2_add(f2_mul(x1, e1), f2_mul(x2, e0)))
+
+    t1 = sparse6(b, c1, c3)
+    s = f6_add(a, b)
+    cross = sparse6(s, f2_add(c0, c1), c3)
+    new_a = f6_add(t0, f6_mul_v(t1))
+    new_b = f6_sub(f6_sub(cross, t0), t1)
+    return (new_a, new_b)
+
+
+def _dbl_step(X: Fp2, Y: Fp2, Z: Fp2, xp: int, yp: int):
+    """Double T and evaluate the tangent line at P.
+
+    Affine tangent at (x, y): lambda = 3x^2/2y; line at P is
+    yp - y - lambda(xp - x). Untwisted (x~ = x w^2, y~ = y w^3) and scaled
+    by 2y * Z^3 (Fp2 factors — killed by final exp):
+      c0 = 2 Y Z^2 * yp,  c1 = -3 X^2 Z * xp,  c3 = X^3 - 2 b' Z^3.
+    Point update (scale Z3 = 8 (YZ)^3):
+      X3 = 2YZ(9X^4 - 8XY^2Z),  Y3 = 36X^3Y^2Z - 27X^6 - 8Y^4Z^2.
+    """
+    X2 = f2_sqr(X)
+    X4 = f2_sqr(X2)
+    Y2 = f2_sqr(Y)
+    Z2 = f2_sqr(Z)
+    YZ = f2_mul(Y, Z)
+    XY2Z = f2_mul(f2_mul(X, Y2), Z)
+
+    c0 = f2_muls(f2_mul(Y, Z2), 2 * yp)
+    c1 = f2_muls(f2_mul(X2, Z), (-3 * xp) % P)
+    c3 = f2_sub(f2_mul(X, X2),
+                f2_muls(f2_mul(_B_TWIST, f2_mul(Z, Z2)), 2))
+
+    X3 = f2_muls(f2_mul(YZ, f2_sub(f2_muls(X4, 9), f2_muls(XY2Z, 8))), 2)
+    Y3 = f2_sub(
+        f2_sub(f2_muls(f2_mul(f2_mul(X, X2), f2_mul(Y2, Z)), 36),
+               f2_muls(f2_mul(X2, X4), 27)),
+        f2_muls(f2_mul(f2_sqr(Y2), Z2), 8))
+    Z3 = f2_muls(f2_mul(YZ, f2_mul(Y2, Z2)), 8)
+    return (X3, Y3, Z3), (c0, c1, c3)
+
+
+def _add_step(X: Fp2, Y: Fp2, Z: Fp2, x2: Fp2, y2: Fp2, xp: int, yp: int):
+    """Add affine Q=(x2,y2) to T and evaluate the chord line at P.
+
+    lambda = (y2 - y)/(x2 - x); with A = y2 Z - Y, B = x2 Z - X:
+    line scaled by B:  c0 = B*yp,  c1 = -A*xp,  c3 = A x2 - B y2.
+    Point update (Z3 = B^3 Z):
+      X3 = B (A^2 Z - (X + x2 Z) B^2),
+      Y3 = A ((2 x2 Z + X) B^2 - A^2 Z) - y2 B^3 Z.
+    """
+    x2Z = f2_mul(x2, Z)
+    A = f2_sub(f2_mul(y2, Z), Y)
+    B = f2_sub(x2Z, X)
+    # the ate loop on a prime-order Q never lands on T = +/-Q mid-loop,
+    # but the frobenius correction points could in principle collide; the
+    # oracle handles those cases, so delegate rather than mis-evaluate
+    if B == _F2_0:
+        raise _NeedOracle
+    A2 = f2_sqr(A)
+    B2 = f2_sqr(B)
+    B3 = f2_mul(B, B2)
+    A2Z = f2_mul(A2, Z)
+
+    c0 = f2_muls(B, yp)
+    c1 = f2_muls(A, (-xp) % P)
+    c3 = f2_sub(f2_mul(A, x2), f2_mul(B, y2))
+
+    X3 = f2_mul(B, f2_sub(A2Z, f2_mul(f2_add(X, x2Z), B2)))
+    Y3 = f2_sub(
+        f2_mul(A, f2_sub(f2_mul(f2_add(f2_muls(x2Z, 2), X), B2), A2Z)),
+        f2_mul(y2, f2_mul(B3, Z)))
+    Z3 = f2_mul(B3, Z)
+    return (X3, Y3, Z3), (c0, c1, c3)
+
+
+class _NeedOracle(Exception):
+    pass
+
+
+def _frobenius_twist(q: bn.G2Point) -> bn.G2Point:
+    """pi(Q) expressed back in twist coordinates.
+
+    Computed via the oracle's untwist/Frobenius (x~ = x w^2 has its Fp2
+    coefficient at Fp6 slot v of the first half; y~ = y w^3 at slot v of
+    the second half), so the twisting constants cannot drift from the
+    oracle's embedding.
+    """
+    u = bn._untwist(q)
+    fx = bn.f12_frobenius(u[0])
+    fy = bn.f12_frobenius(u[1])
+    # fx must be (0, X', 0 | 0, 0, 0), fy must be (0,0,0 | 0, Y', 0)
+    assert fx[0][0] == FP2_ZERO and fx[0][2] == FP2_ZERO \
+        and fx[1] == bn.FP6_ZERO, "frobenius x not in w^2 position"
+    assert fy[0] == bn.FP6_ZERO and fy[1][0] == FP2_ZERO \
+        and fy[1][2] == FP2_ZERO, "frobenius y not in w^3 position"
+    return (fx[0][1], fy[1][1])
+
+
+_ATE_BITS = bin(6 * bn.U + 2)[3:]
+
+
+def miller_loop(q: bn.G2Point, p_at: bn.G1Point):
+    if q is None or p_at is None:
+        return FP12_ONE
+    xp, yp = p_at
+    x2, y2 = q
+    T = (x2, y2, _F2_1)
+    f = FP12_ONE
+    for bit in _ATE_BITS:
+        T, line = _dbl_step(*T, xp, yp)
+        f = _sparse_013(f12_sqr(f), *line)
+        if bit == "1":
+            T, line = _add_step(*T, x2, y2, xp, yp)
+            f = _sparse_013(f, *line)
+    q1 = _frobenius_twist(q)
+    q2 = _frobenius_twist(q1)
+    nq2 = (q2[0], f2_neg(q2[1]))
+    T, line = _add_step(*T, q1[0], q1[1], xp, yp)
+    f = _sparse_013(f, *line)
+    _, line = _add_step(*T, nq2[0], nq2[1], xp, yp)
+    f = _sparse_013(f, *line)
+    return f
+
+
+def multi_pairing(pairs):
+    """prod e(Pi, Qi) with a shared final exponentiation."""
+    try:
+        f = FP12_ONE
+        for p_at, q in pairs:
+            if p_at is None or q is None:
+                continue
+            f = f12_mul(f, miller_loop(q, p_at))
+        return bn._full(f)
+    except _NeedOracle:  # pragma: no cover — degenerate correction points
+        return bn.multi_pairing(pairs)
+
+
+def pairing(q: bn.G2Point, p_at: bn.G1Point):
+    assert bn.g1_is_on_curve(p_at), "P not on G1"
+    assert bn.g2_is_on_curve(q), "Q not on E'"
+    return multi_pairing([(p_at, q)])
+
+
+def pairing_check(pairs) -> bool:
+    return multi_pairing(pairs) == FP12_ONE

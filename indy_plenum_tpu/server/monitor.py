@@ -43,11 +43,11 @@ class Monitor:
         self._timer = timer
         self._bus = bus
         self._config = config
+        # digest -> finalisation timestamp (latency measurement base)
+        self._finalised_at: Dict[str, float] = {}
         self._throughputs: List[WindowedThroughputMeasurement] = []
         self._latencies: List[LatencyMeasurement] = []
         self.reset(num_instances)
-        # digest -> finalisation timestamp (latency measurement base)
-        self._finalised_at: Dict[str, float] = {}
         self.degradation_votes = 0  # observability / tests
 
         self._check_timer = RepeatingTimer(
@@ -80,12 +80,24 @@ class Monitor:
             for _ in range(num_instances)]
         # latency bases from before the reset are meaningless against the
         # new measurements (and would otherwise leak across view changes)
-        if hasattr(self, "_finalised_at"):
-            self._finalised_at.clear()
+        self._finalised_at.clear()
 
     def request_finalised(self, digest: str) -> None:
         self._finalised_at.setdefault(
             digest, self._timer.get_current_time())
+        # opportunistic TTL pruning: on a single-instance node the check
+        # timer never runs, and digests executed via catchup emit no
+        # master Ordered — without this the dict grows for the process
+        # lifetime
+        if len(self._finalised_at) % 1024 == 0:
+            self._prune_finalised()
+
+    def _prune_finalised(self) -> None:
+        now = self._timer.get_current_time()
+        ttl = self._config.INSTANCE_CHANGE_TIMEOUT
+        for d in [d for d, t in self._finalised_at.items()
+                  if now - t > ttl]:
+            del self._finalised_at[d]
 
     def requests_ordered(self, inst_id: int, digests: List[str]) -> None:
         if inst_id >= len(self._throughputs):
@@ -138,13 +150,7 @@ class Monitor:
         return master - (sum(backups) / len(backups)) > self._config.OMEGA
 
     def service_check(self) -> None:
-        # prune latency bases the master never consumed (e.g. batches that
-        # executed via catchup emit no Ordered) — bounded memory
-        now = self._timer.get_current_time()
-        ttl = self._config.INSTANCE_CHANGE_TIMEOUT
-        stale = [d for d, t in self._finalised_at.items() if now - t > ttl]
-        for d in stale:
-            del self._finalised_at[d]
+        self._prune_finalised()
         if self.is_master_degraded():
             self.degradation_votes += 1
             ratio = self.master_throughput_ratio()

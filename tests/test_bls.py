@@ -144,3 +144,121 @@ def test_state_proof_read_from_single_node():
         reply2.multi_sig.participants = reply2.multi_sig.participants[:f]
         assert not verify_proved_reply(reply2, pool_keys,
                                        min_participants=n - f)
+
+
+# --- fast path pinned against the oracle (bn254_fast vs bn254) -------------
+
+
+def test_fast_scalar_muls_match_oracle():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+    from indy_plenum_tpu.crypto.bls import bn254_fast as fast
+
+    for k in (0, 1, 2, 3, 17, 255, 2**64 + 3, bn.R - 1, bn.R, bn.R + 7,
+              0x1234567890abcdef1234567890abcdef):
+        assert fast.g1_mul(bn.G1_GEN, k) == bn.g1_mul(bn.G1_GEN, k), k
+        assert fast.g2_mul(bn.G2_GEN, k) == bn.g2_mul(bn.G2_GEN, k), k
+
+
+def test_fast_pairing_matches_oracle_and_is_bilinear():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+    from indy_plenum_tpu.crypto.bls import bn254_fast as fast
+
+    for a, b in ((12345, 67890), (1, 1), (bn.R - 2, 3)):
+        p = fast.g1_mul(bn.G1_GEN, a)
+        q = fast.g2_mul(bn.G2_GEN, b)
+        assert fast.pairing(q, p) == bn.pairing(q, p), (a, b)
+    # e(aP, bQ) == e(abP, Q)
+    p7 = fast.g1_mul(bn.G1_GEN, 7)
+    q11 = fast.g2_mul(bn.G2_GEN, 11)
+    assert fast.pairing(q11, p7) == fast.pairing(
+        bn.G2_GEN, fast.g1_mul(bn.G1_GEN, 77))
+
+
+def test_fast_pairing_check_and_sums():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+    from indy_plenum_tpu.crypto.bls import bn254_fast as fast
+
+    p = fast.g1_mul(bn.G1_GEN, 31337)
+    q = fast.g2_mul(bn.G2_GEN, 424242)
+    assert fast.pairing_check([(p, q), (bn.g1_neg(p), q)])
+    assert not fast.pairing_check([(p, q), (p, q)])
+
+    pts1 = [fast.g1_mul(bn.G1_GEN, k) for k in (5, 9, 31, bn.R - 1)]
+    acc = None
+    for x in pts1:
+        acc = bn.g1_add(acc, x)
+    assert fast.g1_sum(pts1) == acc
+    pts2 = [fast.g2_mul(bn.G2_GEN, k) for k in (4, 8, 15)]
+    acc2 = None
+    for x in pts2:
+        acc2 = bn.g2_add(acc2, x)
+    assert fast.g2_sum(pts2) == acc2
+
+
+def test_out_of_subgroup_g2_point_rejected():
+    """The twist E'(Fp2) has order R*(2P-R): an on-curve point outside the
+    R-subgroup must fail g2_in_subgroup (both oracle and fast path). A
+    scalar ladder that reduces k mod R computes [R mod R]Q = O for EVERY
+    point and silently accepts such keys (wrong-subgroup key attack)."""
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+    from indy_plenum_tpu.crypto.bls import bn254_fast as fast
+
+    # Tonelli-Shanks square root in Fp2 (test-only helper)
+    order = bn.P * bn.P - 1
+
+    def is_qr(a):
+        return a == (0, 0) or bn.f2_pow(a, order // 2) == (1, 0)
+
+    def f2_sqrt(a):
+        q, s = order, 0
+        while q % 2 == 0:
+            q //= 2
+            s += 1
+        z = None
+        for zc in ((2, 1), (1, 1), (3, 1), (1, 2), (5, 3)):
+            if not is_qr(zc):
+                z = zc
+                break
+        assert z is not None
+        m, c = s, bn.f2_pow(z, q)
+        t, r = bn.f2_pow(a, q), bn.f2_pow(a, (q + 1) // 2)
+        while t != (1, 0):
+            i, t2 = 0, t
+            while t2 != (1, 0):
+                t2 = bn.f2_sqr(t2)
+                i += 1
+            b = bn.f2_pow(c, 1 << (m - i - 1))
+            m, c = i, bn.f2_sqr(b)
+            t, r = bn.f2_mul(t, c), bn.f2_mul(r, b)
+        return r
+
+    # find an on-curve point; with cofactor 2P-R >> 1, a random point is
+    # essentially never in the R-subgroup
+    found = None
+    for xi in range(1, 200):
+        x = (xi, 7)
+        rhs = bn.f2_add(bn.f2_mul(bn.f2_sqr(x), x), bn.B2)
+        if not is_qr(rhs):
+            continue
+        y = f2_sqrt(rhs)
+        pt = (x, y)
+        assert bn.g2_is_on_curve(pt)
+        if bn.g2_mul(pt, 1) == pt:  # sanity
+            found = pt
+            break
+    assert found is not None
+    # confirmed out of subgroup by an unreduced [R] ladder
+    assert not fast.g2_in_subgroup(found)
+    assert not bn.g2_in_subgroup(found)
+    # and the real generator still passes
+    assert fast.g2_in_subgroup(bn.G2_GEN)
+    assert bn.g2_in_subgroup(bn.G2_GEN)
+
+    # end to end: such a key is rejected by the verifier
+    from indy_plenum_tpu.crypto.bls.bls_crypto import (
+        BlsCryptoVerifier, g2_to_bytes)
+    from indy_plenum_tpu.utils.base58 import b58encode
+
+    bad_pk = b58encode(g2_to_bytes(found))
+    assert not BlsCryptoVerifier.verify_sig(
+        b58encode(b"\x00" * 64), b"msg", bad_pk)

@@ -1,0 +1,101 @@
+"""Looper: the production event loop driving timers and transports.
+
+Reference: stp_core/loop/looper.py (`Looper`, `Prodable`) and motor.py
+(`Motor`). The reference wraps asyncio; here the loop is an explicit
+synchronous pump — deterministic, exception-isolating, and trivially
+embeddable in tests — that services the shared QueueTimer and *prods*
+every registered prodable (ZStacks, nodes) each pass, sleeping only when
+a pass did no work.
+
+A raising prodable/timer callback is logged and isolated (the reference
+Looper's per-prodable error guard): one faulty component must not stall
+the node's clock or its peers' IO.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+from .timer import QueueTimer, TimerService
+
+logger = logging.getLogger(__name__)
+
+
+class Prodable:
+    """Anything the loop pumps: return the amount of work done."""
+
+    def prod(self) -> int:  # pragma: no cover — interface
+        raise NotImplementedError
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class Looper:
+    def __init__(self, timer: Optional[TimerService] = None,
+                 idle_sleep: float = 0.002):
+        # epoch-aligned monotonic clock: protocol timestamps (ppTime) are
+        # wall-clock epoch seconds, but scheduling must never jump backwards
+        epoch_offset = time.time() - time.monotonic()
+        self.timer = timer or QueueTimer(
+            lambda: epoch_offset + time.monotonic())
+        self._prodables: List = []
+        self._idle_sleep = idle_sleep
+        self.errors = 0
+
+    def add(self, prodable) -> None:
+        self._prodables.append(prodable)
+        if hasattr(prodable, "start"):
+            try:
+                prodable.start()
+            except NotImplementedError:
+                pass
+
+    def remove(self, prodable) -> None:
+        if prodable in self._prodables:
+            self._prodables.remove(prodable)
+
+    def _pump_once(self) -> int:
+        worked = 0
+        try:
+            worked += self.timer.service()
+        except Exception:  # noqa: BLE001 — isolate faulty callbacks
+            logger.exception("timer callback raised")
+            self.errors += 1
+        for prodable in list(self._prodables):
+            try:
+                fn = getattr(prodable, "prod", None) or prodable.service
+                worked += fn() or 0
+            except Exception:  # noqa: BLE001
+                logger.exception("prodable %r raised", prodable)
+                self.errors += 1
+        return worked
+
+    def run_for(self, seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            if self._pump_once() == 0:
+                time.sleep(self._idle_sleep)
+
+    def run_until(self, condition: Callable[[], bool],
+                  timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if condition():
+                return True
+            if self._pump_once() == 0:
+                time.sleep(self._idle_sleep)
+        return condition()
+
+    def shutdown(self) -> None:
+        for prodable in self._prodables:
+            if hasattr(prodable, "stop"):
+                try:
+                    prodable.stop()
+                except Exception:  # noqa: BLE001
+                    logger.exception("prodable stop raised")
+        self._prodables.clear()

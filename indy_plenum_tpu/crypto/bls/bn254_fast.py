@@ -21,7 +21,7 @@ oracle equivalence tests are the safety net for the embedding layout.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 from . import bn254 as bn
 from .bn254 import (
@@ -43,10 +43,9 @@ from .bn254 import (
 )
 
 Fp2 = Tuple[int, int]
-_INV2 = pow(2, P - 2, P)
 
-# twist curve constant b' = 3/xi  (E': y^2 = x^3 + b')
-_B_TWIST = f2_mul((3, 0), f2_inv(bn.XI))
+# twist curve constant b' = 3/xi (E': y^2 = x^3 + b') — the oracle's B2
+_B_TWIST = bn.B2
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,36 @@
+"""Node-to-node transport: authenticated ZMQ stacks.
+
+Reference: stp_zmq/ (ZStack and friends). See :mod:`.zstack` for the
+CurveZMQ ROUTER stack and :mod:`.keys` for key management.
+"""
+from ..common.event_bus import ExternalBus
+from .keys import curve_keypair_from_seed
+from .zstack import ZStack
+
+__all__ = ["ZStack", "ZStackNetwork", "curve_keypair_from_seed"]
+
+
+class ZStackNetwork:
+    """Adapter: one node's ZStack as the Node composition's network seam
+    (the same ``create_peer`` contract the simulation's SimNetwork has)."""
+
+    def __init__(self, stack: ZStack):
+        self.stack = stack
+        self.bus = None
+
+    def create_peer(self, name: str) -> ExternalBus:
+        assert name == self.stack.name, (name, self.stack.name)
+
+        def send_handler(msg, dst=None):
+            if isinstance(dst, str):
+                dst = [dst]
+            self.stack.send(msg, dst)
+
+        self.bus = ExternalBus(send_handler)
+        self.stack.on_message = self.bus.process_incoming
+        return self.bus
+
+    def mark_connected(self, peers) -> None:
+        """Static-topology connection state (socket-level liveness events
+        arrive with the keep-alive/monitor layer)."""
+        self.bus.update_connecteds(set(peers))

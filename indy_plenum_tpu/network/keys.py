@@ -1,0 +1,29 @@
+"""Stack key management: deterministic CurveZMQ keypairs from seeds.
+
+Reference: plenum's key-init utilities (plenum/common/keygen_utils.py,
+stp_core key directories). A node's transport identity is its Curve25519
+keypair; the pool's key registry (here: a dict name -> public key, later
+fed from the pool ledger) is what lets the ZAP authenticator pin every
+inbound connection to a known validator.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Tuple
+
+import zmq
+import zmq.utils.z85 as z85
+
+
+def curve_keypair_from_seed(seed: bytes) -> Tuple[bytes, bytes]:
+    """(public_z85, secret_z85) derived deterministically from ``seed``.
+
+    Any 32 bytes are a valid Curve25519 secret (libzmq clamps); hashing
+    the seed decouples the wire key from other uses of the same seed.
+    """
+    if len(seed) != 32:
+        raise ValueError("seed must be 32 bytes")
+    secret_raw = hashlib.sha256(b"zstack-curve" + seed).digest()
+    secret_z85 = z85.encode(secret_raw)
+    public_z85 = zmq.curve_public(secret_z85)
+    return public_z85, secret_z85

@@ -1,0 +1,250 @@
+"""Authenticated node-to-node transport: ZMQ ROUTER + CurveZMQ.
+
+Reference: stp_zmq/zstack.py (`ZStack`, `KITZStack`) and stp_zmq's ZAP
+authenticator. Each node binds ONE ROUTER listener in curve-server mode
+and opens a curve-client DEALER per peer. A minimal in-process ZAP handler
+admits only Curve25519 keys from the pool registry, and — the part that
+makes the byzantine tests honest — every inbound message is attributed by
+the AUTHENTICATED curve key of its connection (ZMQ's User-Id metadata,
+set by our ZAP handler), never by any name the bytes claim. A validator
+cannot speak under another validator's name, and an unknown key cannot
+complete the handshake at all.
+
+Outgoing messages per peer are coalesced into one ``Batch`` envelope per
+service() flush (reference: plenum/common/batched.py), bounded by
+``OUTGOING_BATCH_SIZE``.
+
+Wire format: msgpack of the registry dict form (``op`` field dispatch).
+"""
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import zmq
+import zmq.utils.z85 as z85
+
+from ..common.messages.message_base import node_message_registry
+from ..common.messages.node_messages import Batch
+from ..common.serializers.serialization import (
+    deserialize_msgpack,
+    serialize_msg,
+)
+from .keys import curve_keypair_from_seed
+
+logger = logging.getLogger(__name__)
+
+_ZAP_ENDPOINT = "inproc://zeromq.zap.01"
+
+
+class ZStack:
+    """One node's transport stack (listener + per-peer connections)."""
+
+    def __init__(self,
+                 name: str,
+                 seed: bytes,
+                 on_message: Optional[Callable] = None,
+                 bind_host: str = "127.0.0.1",
+                 bind_port: int = 0,
+                 max_batch: int = 100,
+                 msg_len_limit: int = 128 * 1024):
+        self.name = name
+        self.public_key, self._secret_key = curve_keypair_from_seed(seed)
+        self.on_message = on_message  # (msg_obj, sender_name) -> None
+        self._max_batch = max_batch
+        self._msg_len_limit = msg_len_limit
+
+        self._ctx = zmq.Context()
+        # ZAP handler must exist before any curve-server socket binds.
+        # ROUTER, not REP: concurrent handshakes (the whole pool connecting
+        # at startup) put several ZAP requests in flight at once, and REP's
+        # strict alternation would wedge the handler.
+        self._zap = self._ctx.socket(zmq.ROUTER)
+        self._zap.bind(_ZAP_ENDPOINT)
+        self._allowed: Dict[bytes, str] = {}  # public_z85 -> node name
+
+        self._listener = self._ctx.socket(zmq.ROUTER)
+        self._listener.setsockopt(zmq.CURVE_SERVER, 1)
+        self._listener.setsockopt(zmq.CURVE_SECRETKEY, self._secret_key)
+        self._listener.setsockopt(zmq.LINGER, 0)
+        self._listener.bind(f"tcp://{bind_host}:{bind_port}")
+        endpoint = self._listener.getsockopt_string(zmq.LAST_ENDPOINT)
+        self.ha: Tuple[str, int] = (bind_host, int(endpoint.rsplit(":", 1)[1]))
+
+        self._remotes: Dict[str, zmq.Socket] = {}
+        self._outbox: Dict[str, List[bytes]] = defaultdict(list)
+        self._poller = zmq.Poller()
+        self._poller.register(self._listener, zmq.POLLIN)
+        self._poller.register(self._zap, zmq.POLLIN)
+        self.received = 0
+        self.rejected_unknown_key = 0
+
+    # --- registry -------------------------------------------------------
+
+    def allow_peer(self, name: str, public_z85: bytes) -> None:
+        """Admit ``name``'s transport key (pool-registry driven)."""
+        self._allowed[bytes(public_z85)] = name
+
+    def disallow_peer(self, name: str) -> None:
+        for key, peer in list(self._allowed.items()):
+            if peer == name:
+                del self._allowed[key]
+
+    def connect(self, name: str, ha: Tuple[str, int],
+                server_public_z85: bytes) -> None:
+        if name in self._remotes:
+            return
+        sock = self._ctx.socket(zmq.DEALER)
+        sock.setsockopt(zmq.CURVE_SERVERKEY, bytes(server_public_z85))
+        sock.setsockopt(zmq.CURVE_PUBLICKEY, self.public_key)
+        sock.setsockopt(zmq.CURVE_SECRETKEY, self._secret_key)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(f"tcp://{ha[0]}:{ha[1]}")
+        self._remotes[name] = sock
+
+    @property
+    def connected_peers(self) -> List[str]:
+        return list(self._remotes)
+
+    # --- sending --------------------------------------------------------
+
+    def send(self, msg, dst: Optional[List[str]] = None) -> None:
+        """Queue ``msg`` (a MessageBase or dict) for peers; coalesced into
+        Batch envelopes at the next service() flush."""
+        data = serialize_msg(msg.as_dict() if hasattr(msg, "as_dict")
+                             else msg)
+        targets = list(self._remotes) if dst is None else dst
+        for peer in targets:
+            if peer in self._remotes:
+                self._outbox[peer].append(data)
+
+    def _flush(self) -> None:
+        for peer, queue in self._outbox.items():
+            sock = self._remotes.get(peer)
+            if sock is None or not queue:
+                continue
+            while queue:
+                chunk, self._outbox[peer] = (queue[:self._max_batch],
+                                             queue[self._max_batch:])
+                queue = self._outbox[peer]
+                if len(chunk) == 1:
+                    payload = chunk[0]
+                else:
+                    payload = serialize_msg(Batch(
+                        messages=list(chunk), signature=None).as_dict())
+                try:
+                    sock.send(payload, flags=zmq.NOBLOCK)
+                except zmq.Again:  # peer HWM reached; drop (UDP-like)
+                    logger.warning("%s: send queue full for %s", self.name,
+                                   peer)
+                    break
+
+    # --- receiving ------------------------------------------------------
+
+    def _service_zap(self) -> None:
+        while True:
+            try:
+                frames = self._zap.recv_multipart(flags=zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            # ROUTER framing: [envelope..., b"", version, request_id,
+            # domain, address, identity, mechanism, credentials...];
+            # CURVE credential = raw 32-byte client key
+            try:
+                split = frames.index(b"")
+            except ValueError:
+                continue
+            envelope, body = frames[:split + 1], frames[split + 1:]
+            if len(body) < 6:
+                continue
+            version, request_id, mechanism = body[0], body[1], body[5]
+            status, user_id = b"400", b""
+            if mechanism == b"CURVE" and len(body) > 6:
+                key_z85 = z85.encode(body[6])
+                if key_z85 in self._allowed:
+                    status, user_id = b"200", key_z85
+                else:
+                    self.rejected_unknown_key += 1
+                    logger.warning("%s: ZAP rejected unknown curve key",
+                                   self.name)
+            self._zap.send_multipart(envelope + [
+                version, request_id, status,
+                b"OK" if status == b"200" else b"unknown key",
+                user_id, b""])
+
+    def _sender_of(self, frame: zmq.Frame) -> Optional[str]:
+        """The AUTHENTICATED peer name: resolved from the connection's
+        curve key (ZAP User-Id), never from claimed content."""
+        try:
+            user_id = frame.get("User-Id")
+        except Exception:  # noqa: BLE001
+            return None
+        if not user_id:
+            return None
+        return self._allowed.get(user_id.encode()
+                                 if isinstance(user_id, str) else user_id)
+
+    def _dispatch(self, payload: bytes, sender: str,
+                  in_batch: bool = False) -> None:
+        if len(payload) > self._msg_len_limit:
+            logger.warning("%s: oversize message from %s dropped",
+                           self.name, sender)
+            return
+        try:
+            data = deserialize_msgpack(payload)
+            msg = node_message_registry.obj_from_dict(data)
+        except Exception as exc:  # noqa: BLE001 — wire data is untrusted
+            logger.warning("%s: bad message from %s: %s", self.name,
+                           sender, exc)
+            return
+        if isinstance(msg, Batch):
+            # byzantine guards: a batch inside a batch is never legitimate
+            # (unbounded recursion), and elements must be bytes (the field
+            # schema also admits str) — validate ALL before dispatching ANY
+            if in_batch:
+                logger.warning("%s: nested BATCH from %s dropped",
+                               self.name, sender)
+                return
+            inners = []
+            for inner in msg.messages:
+                if not isinstance(inner, (bytes, bytearray)):
+                    logger.warning("%s: non-bytes BATCH element from %s",
+                                   self.name, sender)
+                    return
+                inners.append(bytes(inner))
+            for inner_payload in inners:
+                self._dispatch(inner_payload, sender, in_batch=True)
+            return
+        self.received += 1
+        if self.on_message is not None:
+            self.on_message(msg, sender)
+
+    def service(self, timeout_ms: int = 0) -> int:
+        """Pump ZAP + inbound + outbound once; returns messages handled."""
+        handled = 0
+        events = dict(self._poller.poll(timeout_ms))
+        if self._zap in events:
+            self._service_zap()
+        if self._listener in events:
+            while True:
+                try:
+                    frames = self._listener.recv_multipart(
+                        flags=zmq.NOBLOCK, copy=False)
+                except zmq.Again:
+                    break
+                payload = frames[-1]
+                sender = self._sender_of(payload)
+                if sender is None:
+                    continue  # unauthenticated — ZAP metadata missing
+                self._dispatch(bytes(payload.buffer), sender)
+                handled += 1
+        self._flush()
+        return handled
+
+    def close(self) -> None:
+        for sock in self._remotes.values():
+            sock.close(0)
+        self._listener.close(0)
+        self._zap.close(0)
+        self._ctx.term()

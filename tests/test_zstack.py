@@ -1,0 +1,195 @@
+"""Tier 3: in-process socket pools over the authenticated ZMQ transport.
+
+VERDICT round-2 item 6: the node-to-node plane is authenticated — sender
+attribution comes from the connection's Curve25519 key (ZAP User-Id), so a
+message forged under another node's name is attributed to its REAL sender,
+and an unknown key cannot complete the handshake at all.
+
+Reference: stp_zmq/zstack.py + stp_zmq tests (test_zstack.py).
+"""
+import hashlib
+import time
+
+import pytest
+
+from indy_plenum_tpu.common.looper import Looper
+from indy_plenum_tpu.common.messages.node_messages import Checkpoint
+from indy_plenum_tpu.network import ZStack, ZStackNetwork
+from indy_plenum_tpu.server.node import Node
+
+
+def seed_of(name: str) -> bytes:
+    return hashlib.sha256(b"zstack-test-" + name.encode()).digest()
+
+
+def make_msg(n: int = 1) -> Checkpoint:
+    return Checkpoint(instId=0, viewNo=0, seqNoStart=1, seqNoEnd=n,
+                      digest="d" * 16)
+
+
+def pump(stacks, seconds: float) -> None:
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        if sum(s.service() for s in stacks) == 0:
+            time.sleep(0.002)
+
+
+def wire(names):
+    stacks = {n: ZStack(n, seed_of(n)) for n in names}
+    for a in stacks.values():
+        for b in stacks.values():
+            if a is not b:
+                a.allow_peer(b.name, b.public_key)
+                a.connect(b.name, b.ha, b.public_key)
+    return stacks
+
+
+def test_messages_flow_and_are_attributed_by_curve_key():
+    stacks = wire(["A", "B"])
+    got = []
+    stacks["A"].on_message = lambda msg, frm: got.append((msg, frm))
+    stacks["B"].send(make_msg(), ["A"])
+    pump(list(stacks.values()), 1.5)
+    assert got, "message did not arrive"
+    msg, frm = got[0]
+    # attribution is the AUTHENTICATED key owner — nothing B put in the
+    # message content can change it
+    assert frm == "B"
+    assert isinstance(msg, Checkpoint)
+    for s in stacks.values():
+        s.close()
+
+
+def test_unknown_curve_key_cannot_deliver():
+    stacks = wire(["A", "B"])
+    attacker = ZStack("evil", seed_of("evil"))
+    # attacker knows A's address and public key but is NOT in A's registry
+    attacker.connect("A", stacks["A"].ha, stacks["A"].public_key)
+    got = []
+    stacks["A"].on_message = lambda msg, frm: got.append((msg, frm))
+    attacker.send(make_msg(), ["A"])
+    pump([*stacks.values(), attacker], 1.5)
+    assert got == []
+    assert stacks["A"].rejected_unknown_key > 0
+    for s in [*stacks.values(), attacker]:
+        s.close()
+
+
+def test_peer_cannot_speak_under_another_name():
+    """C is a legitimate pool member, but anything it sends is attributed
+    to C by its curve key — it cannot inject votes as B."""
+    stacks = wire(["A", "B", "C"])
+    got = []
+    stacks["A"].on_message = lambda msg, frm: got.append(frm)
+    stacks["C"].send(make_msg(), ["A"])
+    pump(list(stacks.values()), 1.5)
+    assert got == ["C"]
+    for s in stacks.values():
+        s.close()
+
+
+def test_batch_coalescing_roundtrip():
+    stacks = wire(["A", "B"])
+    got = []
+    stacks["A"].on_message = lambda msg, frm: got.append(msg)
+    for i in range(25):
+        stacks["B"].send(make_msg(i + 1), ["A"])
+    pump(list(stacks.values()), 1.5)
+    assert len(got) == 25
+    assert {m.seqNoEnd for m in got} == set(range(1, 26))
+    for s in stacks.values():
+        s.close()
+
+
+def test_socket_pool_orders_requests_end_to_end():
+    """A real 4-node pool over real sockets: full Node stacks, Looper
+    runtime, client requests ordered and executed everywhere."""
+    from indy_plenum_tpu.common.constants import TRUSTEE
+    from indy_plenum_tpu.common.request import Request
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.crypto.signers import DidSigner
+    from indy_plenum_tpu.ledger.genesis import genesis_nym_txn
+
+    names = [f"node{i}" for i in range(4)]
+    config = getConfig({"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.02})
+    trustee = DidSigner(b"\x09" * 32)
+    genesis = [genesis_nym_txn(trustee.identifier, trustee.verkey,
+                               role=TRUSTEE)]
+
+    looper = Looper()
+    stacks = wire(names)
+    nodes = []
+    for name in names:
+        net = ZStackNetwork(stacks[name])
+        node = Node(name, names, looper.timer, net, config=config,
+                    domain_genesis=[dict(t) for t in genesis],
+                    seed_keys={trustee.identifier: trustee.verkey})
+        net.mark_connected(set(names) - {name})
+        node.start()
+        looper.add(stacks[name])
+        nodes.append(node)
+
+    reqs = []
+    for i in range(6):
+        from indy_plenum_tpu.common.constants import (
+            NYM, TARGET_NYM, TXN_TYPE, VERKEY)
+
+        target = DidSigner(hashlib.sha256(b"sock-target-%d" % i).digest())
+        req = Request(identifier=trustee.identifier, reqId=i + 1,
+                      operation={TXN_TYPE: NYM,
+                                 TARGET_NYM: target.identifier,
+                                 VERKEY: target.verkey})
+        trustee.sign_request(req)
+        reqs.append(req)
+
+    # warm the device verify kernel OUTSIDE the liveness budget (first XLA
+    # compile of the Ed25519 batch kernel can take tens of seconds)
+    assert nodes[0].authnr.authenticate_batch([reqs[0]]).all()
+
+    for i, req in enumerate(reqs):
+        nodes[i % 4].submit_client_request(req, client_id="cli")
+
+    ok = looper.run_until(
+        lambda: all(len(n.ordered_digests) == 6 for n in nodes),
+        timeout=30)
+    assert ok, [len(n.ordered_digests) for n in nodes]
+    logs = [tuple(n.ordered_digests) for n in nodes]
+    assert len(set(logs)) == 1
+    for node in nodes:
+        for req in reqs:
+            assert node.get_nym_data(req.operation["dest"]) is not None
+    looper.shutdown()
+    for node in nodes:
+        node.stop()
+    for s in stacks.values():
+        s.close()
+
+
+def test_malformed_batch_from_authenticated_peer_is_contained():
+    """An authenticated pool member sending nested/malformed BATCH
+    envelopes must not crash the receiver's service loop (DoS guard)."""
+    from indy_plenum_tpu.common.messages.node_messages import Batch
+    from indy_plenum_tpu.common.serializers.serialization import (
+        serialize_msg)
+
+    stacks = wire(["A", "B"])
+    got = []
+    stacks["A"].on_message = lambda msg, frm: got.append(msg)
+
+    # deeply nested batches (recursion bomb) — raw bytes via the dealer
+    payload = serialize_msg(make_msg().as_dict())
+    for _ in range(1200):
+        payload = serialize_msg(
+            Batch(messages=[payload], signature=None).as_dict())
+    sock = stacks["B"]._remotes["A"]
+    sock.send(payload)
+    # batch with a str element (schema admits str; dispatch must not crash)
+    sock.send(serialize_msg(
+        Batch(messages=["not-bytes"], signature=None).as_dict()))
+    # a healthy message afterwards still flows — the stack survived
+    stacks["B"].send(make_msg(42), ["A"])
+    pump(list(stacks.values()), 1.5)
+    assert [m.seqNoEnd for m in got] == [42]
+    for s in stacks.values():
+        s.close()

@@ -1,0 +1,174 @@
+"""The pool client: submit requests, collect quorum replies.
+
+Reference: plenum/client/client.py (`Client`). Transport-agnostic: the
+composition supplies ``send(request, node_name, client_id)`` (a ZMQ client
+stack in production, direct node handles in the simulation) and routes
+every node->client message into :meth:`process_node_message`.
+
+Write path: submit to one or more nodes, collect REPLYs, and accept a
+result once f+1 DISTINCT nodes returned the identical committed txn —
+at least one of them is honest. Read path (GET_NYM): submit to ONE node
+and accept its single reply iff the carried state proof verifies against
+the pool's BLS keys (client/state_proof.verify_proved_reply) — a proved
+read from one node is as trustworthy as f+1 matching replies.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.constants import GET_NYM, TARGET_NYM
+from ..common.messages.node_messages import Reply, RequestAck, RequestNack
+from ..common.request import Request
+from ..utils.base58 import b58decode
+from .state_proof import StateProofReply, verify_proved_reply
+
+logger = logging.getLogger(__name__)
+
+# a proved read's multi-signature must be recent: an old root with a
+# genuine pool signature could otherwise serve provably-signed STALE state
+DEFAULT_PROOF_MAX_AGE = 300.0  # seconds
+
+
+class PendingRequest:
+    def __init__(self, request: Request, needed: int):
+        self.request = request
+        self.needed = needed
+        self.replies: Dict[str, dict] = {}  # node -> result
+        self.acks: set = set()
+        self.nacks: Dict[str, str] = {}
+        self.result: Optional[dict] = None  # set once quorum reached
+
+    def add_reply(self, node: str, result: dict) -> None:
+        self.replies[node] = result
+        if self.result is not None:
+            return
+        by_content: Dict[str, List[str]] = {}
+        for n, r in self.replies.items():
+            by_content.setdefault(repr(sorted(r.items())), []).append(n)
+        for content, nodes in by_content.items():
+            if len(nodes) >= self.needed:
+                self.result = self.replies[nodes[0]]
+                return
+
+
+class Client:
+    def __init__(self,
+                 name: str,
+                 validators: List[str],
+                 send: Callable[[Request, str, str], Any],
+                 pool_bls_keys: Optional[Dict[str, str]] = None,
+                 now_provider: Callable[[], float] = time.time,
+                 proof_max_age: float = DEFAULT_PROOF_MAX_AGE):
+        self.name = name
+        self._validators = list(validators)
+        self._send = send
+        self._pool_bls_keys = dict(pool_bls_keys or {})
+        self._now = now_provider
+        self._proof_max_age = proof_max_age
+        n = len(self._validators)
+        self._f = (n - 1) // 3
+        self.pending: Dict[str, PendingRequest] = {}  # digest -> state
+        self.proved_reads: Dict[str, dict] = {}  # digest -> verified result
+
+    # ------------------------------------------------------------------
+
+    def submit_write(self, request: Request,
+                     to: Optional[List[str]] = None) -> str:
+        """Send a write to ``to`` (default: all validators — the client
+        needs f+1 REPLYs, and up to f nodes may ignore it)."""
+        targets = to if to is not None else list(self._validators)
+        self.pending[request.digest] = PendingRequest(
+            request, needed=self._f + 1)
+        for node in targets:
+            self._send(request, node, self.name)
+        return request.digest
+
+    def submit_read(self, request: Request,
+                    to: Optional[str] = None) -> str:
+        """Send a proved read to ONE node."""
+        node = to or self._validators[0]
+        self.pending[request.digest] = PendingRequest(request, needed=1)
+        self._send(request, node, self.name)
+        return request.digest
+
+    # ------------------------------------------------------------------
+
+    def process_node_message(self, node_name: str, msg) -> None:
+        if isinstance(msg, Reply):
+            self._process_reply(node_name, dict(msg.result))
+        elif isinstance(msg, RequestNack):
+            self._process_nack(node_name, msg)
+        elif isinstance(msg, RequestAck):
+            self._process_ack(node_name, msg)
+
+    def _match_pending(self, identifier, req_id) -> Optional[PendingRequest]:
+        for state in self.pending.values():
+            if (state.request.identifier == identifier
+                    and state.request.reqId == req_id):
+                return state
+        return None
+
+    def _process_ack(self, node_name: str, msg: RequestAck) -> None:
+        state = self._match_pending(msg.identifier, msg.reqId)
+        if state is not None:
+            state.acks.add(node_name)
+
+    def _process_nack(self, node_name: str, msg: RequestNack) -> None:
+        state = self._match_pending(msg.identifier, msg.reqId)
+        if state is not None:
+            state.nacks[node_name] = msg.reason
+
+    def _process_reply(self, node_name: str, result: dict) -> None:
+        state = self._match_pending(result.get("identifier"),
+                                    result.get("reqId"))
+        if state is None:
+            return
+        digest = state.request.digest
+        # the single-reply proved path applies ONLY when WE asked a proved
+        # read: a byzantine node must not be able to short-circuit a
+        # write's f+1 quorum by attaching a (genuine) proof of something
+        if state.request.txn_type == GET_NYM:
+            proof = result.get("state_proof")
+            if proof is not None and self._verify_proved_read(
+                    state.request, result, proof):
+                self.proved_reads[digest] = result
+                state.result = result
+            else:
+                logger.warning("client %s: unverifiable proved reply "
+                               "from %s dropped", self.name, node_name)
+            return
+        state.add_reply(node_name, result)
+
+    def _verify_proved_read(self, request: Request, result: dict,
+                            proof: dict) -> bool:
+        # the proof must be about the key WE asked for (from our own
+        # request), never the key the reply claims to answer
+        dest = request.operation.get(TARGET_NYM)
+        if not isinstance(dest, str) or result.get("dest") != dest:
+            return False
+        try:
+            reply = StateProofReply(
+                key=dest.encode(),
+                value=result.get("data"),
+                root=b58decode(proof["root_hash"]),
+                proof=proof["proof_nodes"],
+                multi_sig_dict=proof.get("multi_signature"))
+        except Exception:  # noqa: BLE001 — reply content is untrusted
+            return False
+        n = len(self._validators)
+        return verify_proved_reply(
+            reply, self._pool_bls_keys, min_participants=n - self._f,
+            now=self._now(), max_age=self._proof_max_age)
+
+    # ------------------------------------------------------------------
+
+    def result(self, digest: str) -> Optional[dict]:
+        state = self.pending.get(digest)
+        return state.result if state else None
+
+    def is_rejected(self, digest: str) -> bool:
+        state = self.pending.get(digest)
+        return bool(state and not state.result
+                    and len(state.nacks) > self._f)

@@ -40,12 +40,18 @@ class StateProofReply:
 
 def verify_proved_reply(reply: StateProofReply,
                         pool_bls_keys: Dict[str, str],
-                        min_participants: int) -> bool:
+                        min_participants: int,
+                        now: Optional[float] = None,
+                        max_age: Optional[float] = None) -> bool:
     """True iff the reply proves (key -> value) under a root co-signed by
     >= min_participants validators (n-f for the reading client).
 
     ``pool_bls_keys``: node name -> BLS pk b58 (from the pool ledger /
-    genesis — the client's trust anchor).
+    genesis — the client's trust anchor). When ``now``/``max_age`` are
+    given, the multi-signature's timestamp must be recent: a byzantine
+    node holding an OLD root with a genuine pool signature could otherwise
+    serve provably-signed stale state (e.g. an absence proof for a key
+    written since).
     """
     # 1. the Merkle proof binds (key, value) to the root
     if not verify_state_proof(reply.root, reply.key, reply.value,
@@ -57,6 +63,10 @@ def verify_proved_reply(reply: StateProofReply,
         return False
     if ms.value.state_root_hash != b58encode(reply.root):
         return False
+    if now is not None and max_age is not None:
+        ts = ms.value.timestamp
+        if not isinstance(ts, (int, float)) or now - ts > max_age:
+            return False
     if len(set(ms.participants)) < min_participants:
         return False
     pks = []

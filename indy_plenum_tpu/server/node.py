@@ -38,8 +38,10 @@ from ..common.messages.node_messages import (
     RequestAck,
     RequestNack,
 )
+from ..common.exceptions import InvalidClientRequest
 from ..common.request import Request
 from ..common.stashing_router import StashingRouter
+from ..common.txn_util import get_from, get_req_id
 from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config, getConfig
 from ..storage.req_id_to_txn import ReqIdrToTxn
@@ -156,6 +158,12 @@ class Node:
             get_view_info=lambda: (self.data.view_no,
                                    list(self.data.primaries)))
         self.req_idr_to_txn = ReqIdrToTxn()
+        from .request_managers.read_request_manager import (
+            ReadRequestManager,
+        )
+
+        self.read_manager = ReadRequestManager(
+            self.boot.db, bls_multi_sig_getter=self._find_multi_sig)
 
         # --- ingress: state-backed authn + propagation ------------------
         self.authnr = CoreAuthNr(verkey_source=self.boot.nym_handler,
@@ -324,11 +332,37 @@ class Node:
     # client ingress
     # ------------------------------------------------------------------
 
+    def _find_multi_sig(self, state_root_b58: str) -> Optional[dict]:
+        if self.bls_replica is None:
+            return None
+        found = self.bls_replica.store.get(state_root_b58)
+        return found.as_dict() if found else None
+
     def submit_client_request(self, req: Request,
                               client_id: Optional[str] = None) -> bool:
         """Entry point a client transport calls. Returns False iff the
-        request was NACKed synchronously (replay); authentication itself is
-        asynchronous (device-batched on the ingress tick)."""
+        request was NACKed synchronously (replay/bad read); authentication
+        of writes is asynchronous (device-batched on the ingress tick).
+        Reads are served immediately by THIS node — the reply carries the
+        proof material that makes one answer trustworthy."""
+        if self.read_manager.is_read(req.txn_type):
+            try:
+                result = self.read_manager.handle(req)
+            except InvalidClientRequest as ex:
+                self._to_client(client_id, RequestNack(
+                    identifier=req.identifier, reqId=req.reqId,
+                    reason=str(ex)))
+                return False
+            except Exception:  # noqa: BLE001 — reads are unauthenticated;
+                # a malformed one must NACK, never crash the ingress path
+                logger.exception("%s: read request failed", self.name)
+                self._to_client(client_id, RequestNack(
+                    identifier=req.identifier, reqId=req.reqId,
+                    reason="malformed read request"))
+                return False
+            result.update(identifier=req.identifier, reqId=req.reqId)
+            self._to_client(client_id, Reply(result=result))
+            return True
         seen = self.req_idr_to_txn.get_by_payload_digest(req.payload_digest)
         if seen is not None:
             lid, seq = seen
@@ -426,6 +460,8 @@ class Node:
                 digest, payload_digest, staged.ledger_id, seq_no)
             reply = Reply(result=dict(
                 txn,
+                identifier=get_from(txn),
+                reqId=get_req_id(txn),
                 stateRootHash=ordered.stateRootHash,
                 txnRootHash=ordered.txnRootHash))
             self.replies[digest] = reply

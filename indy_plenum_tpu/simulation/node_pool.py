@@ -110,6 +110,32 @@ class NodePool:
         """Client sends a request to exactly ONE node (the real topology)."""
         return self.node(node_name).submit_client_request(req, client_id)
 
+    def make_client(self, name: str = "client1"):
+        """A pool client wired to the sim nodes (direct-call transport)."""
+        from ..client.client import Client
+
+        pool_bls_keys = {}
+        if self.bls_keys is not None:
+            pool_bls_keys = {n: pk
+                             for n, (kp, pk, pop) in self.bls_keys.items()}
+        return Client(
+            name, self.validators,
+            send=lambda req, node, cid: self.node(node)
+            .submit_client_request(req, client_id=cid),
+            pool_bls_keys=pool_bls_keys,
+            now_provider=self.timer.get_current_time)
+
+    def pump_client(self, client) -> None:
+        """Deliver queued node->client messages to ``client``."""
+        for node in self.nodes:
+            keep = []
+            for cid, msg in node.client_outbox:
+                if cid == client.name:
+                    client.process_node_message(node.name, msg)
+                else:
+                    keep.append((cid, msg))
+            node.client_outbox = keep
+
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)
 

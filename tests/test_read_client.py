@@ -1,0 +1,197 @@
+"""Read request path + client submit path (VERDICT round-2 items 7 and 9).
+
+Reference: plenum/server/request_managers/read_request_manager.py,
+plenum/client/client.py. GET_NYM replies carry {value, SMT proof, BLS
+multi-sig} so a client can trust ONE node; GET_TXN replies carry an RFC
+6962 audit path; the write client collects f+1 matching REPLYs.
+"""
+import copy
+
+from indy_plenum_tpu.common.constants import (
+    DOMAIN_LEDGER_ID,
+    GET_NYM,
+    GET_TXN,
+    TARGET_NYM,
+    TXN_TYPE,
+)
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+
+def _write_one_nym(pool, client):
+    req = pool.make_nym_request()
+    digest = client.submit_write(req)
+    pool.run_for(15)
+    pool.pump_client(client)
+    return req, digest
+
+
+def test_client_collects_f_plus_1_matching_write_replies():
+    pool = NodePool(4, seed=41)
+    client = pool.make_client()
+    req, digest = _write_one_nym(pool, client)
+    result = client.result(digest)
+    assert result is not None
+    assert result["txnMetadata"]["seqNo"] >= 1
+    # at least f+1 distinct nodes replied identically
+    assert len(pool.make_client().pending) == 0  # sanity: fresh client
+    state = client.pending[digest]
+    assert len(state.replies) >= 2
+    assert len(state.acks) >= 2
+
+
+def test_get_nym_proved_read_trusts_single_node():
+    pool = NodePool(4, seed=42, bls=True)
+    client = pool.make_client()
+    req, _ = _write_one_nym(pool, client)
+
+    read = Request(identifier="reader", reqId=100,
+                   operation={TXN_TYPE: GET_NYM,
+                              TARGET_NYM: req.operation["dest"]})
+    digest = client.submit_read(read, to="node2")  # ONE node only
+    pool.pump_client(client)
+    result = client.result(digest)
+    assert result is not None, "proved read not accepted"
+    assert result["data"] is not None
+    assert digest in client.proved_reads
+
+
+def test_forged_proved_reads_rejected():
+    """Forging the value, the proof, or the multi-sig each breaks the
+    verification chain — the client drops the reply."""
+    pool = NodePool(4, seed=43, bls=True)
+    client = pool.make_client()
+    req, _ = _write_one_nym(pool, client)
+
+    read = Request(identifier="reader", reqId=101,
+                   operation={TXN_TYPE: GET_NYM,
+                              TARGET_NYM: req.operation["dest"]})
+    node = pool.node("node1")
+    node.submit_client_request(read, client_id=client.name)
+    (cid, reply), = [(c, m) for c, m in node.client_outbox
+                     if c == client.name]
+    node.client_outbox.clear()
+    genuine = dict(reply.result)
+    assert client._verify_proved_read(read, genuine,
+                                      genuine["state_proof"])
+
+    forged_value = copy.deepcopy(genuine)
+    forged_value["data"] = b"attacker-chosen-bytes"
+    assert not client._verify_proved_read(
+        read, forged_value, forged_value["state_proof"])
+
+    forged_proof = copy.deepcopy(genuine)
+    proof_bytes = bytearray(forged_proof["state_proof"]["proof_nodes"])
+    proof_bytes[-1] ^= 0xFF
+    forged_proof["state_proof"]["proof_nodes"] = bytes(proof_bytes)
+    assert not client._verify_proved_read(
+        read, forged_proof, forged_proof["state_proof"])
+
+    forged_sig = copy.deepcopy(genuine)
+    ms = forged_sig["state_proof"]["multi_signature"]
+    ms["value"]["state_root_hash"] = ms["value"]["txn_root_hash"]
+    assert not client._verify_proved_read(
+        read, forged_sig, forged_sig["state_proof"])
+
+    # fewer than n-f participants also fails (weak multi-sig)
+    forged_part = copy.deepcopy(genuine)
+    forged_part["state_proof"]["multi_signature"]["participants"] = \
+        forged_part["state_proof"]["multi_signature"]["participants"][:1]
+    assert not client._verify_proved_read(
+        read, forged_part, forged_part["state_proof"])
+
+    # a (genuinely proved) answer about a DIFFERENT key than we asked
+    other = Request(identifier="reader", reqId=105,
+                    operation={TXN_TYPE: GET_NYM,
+                               TARGET_NYM: "SomeOtherDid"})
+    assert not client._verify_proved_read(
+        other, genuine, genuine["state_proof"])
+
+    # a stale (but genuinely signed) root is rejected by the freshness
+    # window: advance the sim clock past the proof max age
+    pool.run_for(client._proof_max_age + 60)
+    assert not client._verify_proved_read(read, genuine,
+                                          genuine["state_proof"])
+
+
+def test_get_txn_returns_txn_with_verifiable_audit_path():
+    from indy_plenum_tpu.common.serializers.serialization import (
+        ledger_txn_serializer,
+    )
+    from indy_plenum_tpu.ledger.merkle_verifier import STH, MerkleVerifier
+    from indy_plenum_tpu.utils.base58 import b58decode
+
+    pool = NodePool(4, seed=44)
+    client = pool.make_client()
+    req, digest = _write_one_nym(pool, client)
+    seq_no = client.result(digest)["txnMetadata"]["seqNo"]
+
+    read = Request(identifier="reader", reqId=102,
+                   operation={TXN_TYPE: GET_TXN,
+                              "ledgerId": DOMAIN_LEDGER_ID,
+                              "data": seq_no})
+    client.submit_read(read, to="node3")
+    pool.pump_client(client)
+    # GET_TXN replies have no state_proof: collected as a normal reply
+    state = client.pending[read.digest]
+    assert state.replies, "no GET_TXN reply"
+    result = next(iter(state.replies.values()))
+    assert result["data"] is not None
+    proof = result["auditProof"]
+    # client-side: the txn bytes are bound to the ledger root
+    v = MerkleVerifier()
+    leaf = ledger_txn_serializer.dumps(result["data"])
+    sth = STH(tree_size=proof["ledgerSize"],
+              sha256_root_hash=b58decode(proof["rootHash"]))
+    assert v.verify_leaf_inclusion(
+        leaf, seq_no - 1, [b58decode(h) for h in proof["auditPath"]], sth)
+
+    # missing txn -> data None
+    read2 = Request(identifier="reader", reqId=103,
+                    operation={TXN_TYPE: GET_TXN,
+                               "ledgerId": DOMAIN_LEDGER_ID, "data": 999})
+    client.submit_read(read2, to="node3")
+    pool.pump_client(client)
+    assert next(iter(
+        client.pending[read2.digest].replies.values()))["data"] is None
+
+
+def test_bad_read_request_nacked():
+    pool = NodePool(4, seed=45)
+    client = pool.make_client()
+    read = Request(identifier="reader", reqId=104,
+                   operation={TXN_TYPE: GET_NYM})  # missing dest
+    assert not pool.node("node0").submit_client_request(
+        read, client_id=client.name)
+    pool.pump_client(client)
+    state = client._match_pending("reader", 104)
+    assert state is None  # never submitted through the client
+
+
+def test_proved_reply_cannot_short_circuit_write_quorum():
+    """A byzantine node attaching a genuine state proof to a WRITE reply
+    must not bypass the f+1 matching-reply quorum."""
+    pool = NodePool(4, seed=46, bls=True)
+    client = pool.make_client()
+    req, digest = _write_one_nym(pool, client)
+    assert client.result(digest) is not None
+
+    # fetch a genuine proved-read reply to use as the attack payload
+    read = Request(identifier="reader", reqId=200,
+                   operation={TXN_TYPE: GET_NYM,
+                              TARGET_NYM: req.operation["dest"]})
+    node = pool.node("node1")
+    node.submit_client_request(read, client_id=client.name)
+    (_, reply), = [(c, m) for c, m in node.client_outbox
+                   if c == client.name]
+    node.client_outbox.clear()
+
+    write2 = pool.make_nym_request()
+    d2 = client.submit_write(write2, to=["node0"])  # pending, no replies
+    evil = dict(reply.result)
+    evil["identifier"] = write2.identifier
+    evil["reqId"] = write2.reqId
+    client._process_reply("node1", evil)
+    # the proved path is reserved for reads WE asked: the write stays
+    # pending until real f+1 replies arrive
+    assert client.result(d2) is None

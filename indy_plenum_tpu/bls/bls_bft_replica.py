@@ -54,6 +54,7 @@ class BlsBftReplica:
         self._verifier = BlsCryptoVerifier()
         self._register = key_register
         self._store = store if store is not None else BlsStore()
+        self.key_register = key_register  # pool manager updates membership
         self._pool_root = pool_state_root_provider or (lambda: "")
         # called with a SuspiciousNode when the culprit re-check identifies
         # a bad signer (process_order cannot raise: ordering must proceed)

@@ -33,6 +33,10 @@ class BlsKeyRegister:
         self._keys[node_name] = pk_b58
         return True
 
+    def remove_key(self, node_name: str) -> None:
+        """Demoted validator: its key must stop counting toward multi-sigs."""
+        self._keys.pop(node_name, None)
+
     def get_key(self, node_name: str) -> Optional[str]:
         return self._keys.get(node_name)
 

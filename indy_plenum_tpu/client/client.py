@@ -87,10 +87,19 @@ class Client:
 
     def submit_read(self, request: Request,
                     to: Optional[str] = None) -> str:
-        """Send a proved read to ONE node."""
-        node = to or self._validators[0]
-        self.pending[request.digest] = PendingRequest(request, needed=1)
-        self._send(request, node, self.name)
+        """Proved reads (GET_NYM) go to ONE node — the reply carries a
+        verifiable proof. Reads WITHOUT a proof surface (GET_TXN) fall
+        back to the f+1 matching-reply quorum across the pool: a single
+        unproved answer is never trusted."""
+        if request.txn_type == GET_NYM:
+            node = to or self._validators[0]
+            self.pending[request.digest] = PendingRequest(request, needed=1)
+            self._send(request, node, self.name)
+        else:
+            self.pending[request.digest] = PendingRequest(
+                request, needed=self._f + 1)
+            for node in self._validators:
+                self._send(request, node, self.name)
         return request.digest
 
     # ------------------------------------------------------------------

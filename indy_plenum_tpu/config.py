@@ -36,6 +36,12 @@ class Config:
     LatencyWindowSize: int = 15
     PerfCheckFreq: float = 10.0  # monitor degradation check cadence (s)
 
+    # --- freshness --------------------------------------------------------
+    # idle pools re-sign their state roots periodically (an empty 3PC
+    # batch): without this, proved reads go stale once writes stop
+    # (reference: STATE_FRESHNESS_UPDATE_INTERVAL)
+    StateFreshnessUpdateInterval: float = 300.0  # 0 disables
+
     # --- view change ------------------------------------------------------
     ToleratePrimaryDisconnection: float = 2.0  # seconds
     OldViewPPRequestInterval: float = 1.0  # re-fetch missing old-view PPs

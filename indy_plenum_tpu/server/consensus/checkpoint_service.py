@@ -115,6 +115,8 @@ class CheckpointService:
     def process_checkpoint(self, cp: Checkpoint, sender: str):
         if getattr(cp, "instId", self._data.inst_id) != self._data.inst_id:
             return DISCARD, "other instance"
+        if sender not in self._data.validators:
+            return DISCARD, "CHECKPOINT from non-validator"
         if cp.viewNo < self._data.view_no:
             return DISCARD, "old view"
         if cp.seqNoEnd <= self._data.stable_checkpoint:

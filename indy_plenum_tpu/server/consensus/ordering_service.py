@@ -200,6 +200,8 @@ class OrderingService:
         # highest seq speculatively applied (or committed) — the in-order
         # apply guard for non-primary re-application
         self._last_applied_seq = 0
+        # when this primary last minted a batch (freshness cadence base)
+        self._last_batch_time = self._get_time()
 
         stasher.subscribe(PrePrepare, self.process_preprepare)
         stasher.subscribe(Prepare, self.process_prepare)
@@ -292,17 +294,34 @@ class OrderingService:
             if not self._can_send_batch():
                 break
             self.send_3pc_batch(ledger_id)
+        self._maybe_send_freshness_batch()
 
-    def send_3pc_batch(self, ledger_id: int = DOMAIN_LEDGER_ID
-                       ) -> Optional[PrePrepare]:
+    def _maybe_send_freshness_batch(self) -> None:
+        """Idle primary: re-sign the state roots periodically with an EMPTY
+        batch (reference: freshness updates). Without this, BLS multi-sigs
+        over the committed roots age out and proved reads from an idle
+        pool stop verifying against any freshness window."""
+        interval = self._config.StateFreshnessUpdateInterval
+        if interval <= 0 or not self._is_master:
+            return
+        if not self._can_send_batch():
+            return
+        now = self._get_time()
+        if now - self._last_batch_time < interval:
+            return
+        self.send_3pc_batch(DOMAIN_LEDGER_ID, allow_empty=True)
+
+    def send_3pc_batch(self, ledger_id: int = DOMAIN_LEDGER_ID,
+                       allow_empty: bool = False) -> Optional[PrePrepare]:
         """Primary: pop finalised requests, apply, emit PRE-PREPARE."""
         if not self._can_send_batch() or self._requests is None:
             return None
         reqs = self._requests.pop_ready(
             ledger_id, self._config.Max3PCBatchSize)
-        if not reqs:
+        if not reqs and not allow_empty:
             return None
         pp_time = int(self._get_time())
+        self._last_batch_time = pp_time
         self._data.pp_seq_no += 1
         state_root = txn_root = None
         discarded = 0
@@ -407,8 +426,13 @@ class OrderingService:
             self._bus.send(RaisedSuspicion(self._data.inst_id, ex))
             return DISCARD, "bad BLS multi-sig"
 
-        # all referenced requests must be finalised here too
-        if self._requests is not None:
+        # all referenced requests must be finalised here too — EXCEPT for
+        # batches at/below our committed height (post-view-change
+        # re-ordering of already-executed batches): their roots come from
+        # the audit ledger and their content may be GC'd after execution
+        committed = (self._executor.committed_seq()
+                     if self._executor is not None else 0)
+        if self._requests is not None and pp.ppSeqNo > committed:
             missing = [d for d in pp.reqIdr
                        if self._requests.get(d) is None]
             if missing:
@@ -496,6 +520,10 @@ class OrderingService:
         verdict = self._common_checks(prepare, key)
         if verdict is not None:
             return verdict
+        if sender not in self._data.validators:
+            # a demoted (or never-admitted) node's votes must not count
+            # toward any certificate
+            return DISCARD, "PREPARE from non-validator"
         primary_name = self._data.primary_name
         if sender == primary_name:
             self._raise_suspicion(sender, Suspicions.PR_FRM_PRIMARY)
@@ -567,6 +595,8 @@ class OrderingService:
         verdict = self._common_checks(commit, key)
         if verdict is not None:
             return verdict
+        if sender not in self._data.validators:
+            return DISCARD, "COMMIT from non-validator"
         votes = self.commits.setdefault(key, {})
         if sender in votes:
             self._raise_suspicion(sender, Suspicions.DUPLICATE_CM_SENT)

@@ -11,13 +11,24 @@ from typing import List
 
 
 class RoundRobinConstantNodesPrimariesSelector:
-    def __init__(self, validators: List[str]):
-        self.validators = list(validators)
+    def __init__(self, validators):
+        """``validators``: a list, or a zero-arg callable returning the
+        CURRENT list — the pool manager can change membership between view
+        changes, and primaries must be picked from the live set."""
+        self._validators = validators
+
+    @property
+    def validators(self) -> List[str]:
+        if callable(self._validators):
+            return list(self._validators())
+        return list(self._validators)
 
     def select_primaries(self, view_no: int, instance_count: int) -> List[str]:
-        n = len(self.validators)
-        return [self.validators[(view_no + i) % n]
+        validators = self.validators
+        n = len(validators)
+        return [validators[(view_no + i) % n]
                 for i in range(instance_count)]
 
     def select_master_primary(self, view_no: int) -> str:
-        return self.validators[view_no % len(self.validators)]
+        validators = self.validators
+        return validators[view_no % len(validators)]

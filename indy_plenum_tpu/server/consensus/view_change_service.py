@@ -141,7 +141,7 @@ class ViewChangeService:
         self._stasher = stasher
         self._config = config or getConfig()
         self._selector = RoundRobinConstantNodesPrimariesSelector(
-            data.validators)
+            lambda: self._data.validators)
         # () -> list of checkpoint values for the VIEW_CHANGE msg
         self._checkpoint_values = checkpoint_values_provider or (
             lambda: [(self._data.view_no, self._data.stable_checkpoint, "stable")])

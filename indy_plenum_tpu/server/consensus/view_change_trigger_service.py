@@ -75,6 +75,9 @@ class ViewChangeTriggerService:
         self._try_start(view_no)
 
     def process_instance_change(self, ic: InstanceChange, sender: str):
+        if sender != self._data.name \
+                and sender not in self._data.validators:
+            return DISCARD, "INSTANCE_CHANGE from non-validator"
         if ic.viewNo <= self._data.view_no:
             return DISCARD, "proposed view not ahead"
         self._record_vote(ic.viewNo, sender)

@@ -22,9 +22,9 @@ material beyond the genesis seed.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
-from ..common.constants import DOMAIN_LEDGER_ID
+from ..common.constants import DOMAIN_LEDGER_ID, POOL_LEDGER_ID
 from ..common.event_bus import InternalBus
 from ..common.messages.internal_messages import (
     CatchupFinished,
@@ -169,11 +169,12 @@ class Node:
         self.authnr = CoreAuthNr(verkey_source=self.boot.nym_handler,
                                  seed_keys=seed_keys)
         self.propagator = Propagator(
-            name, self.data.quorums, self.external_bus,
+            name, lambda: self.data.quorums, self.external_bus,
             on_finalised=self._on_request_finalised,
             on_needs_auth=self._enqueue_for_auth,
             is_already_committed=lambda r: self.req_idr_to_txn
-            .get_by_payload_digest(r.payload_digest) is not None)
+            .get_by_payload_digest(r.payload_digest) is not None,
+            is_validator=lambda s: s in self.data.validators)
         self.requests_pool = NodeRequestsPool(
             self.propagator,
             classify=self.boot.write_manager.ledger_id_for_request)
@@ -189,7 +190,6 @@ class Node:
         self.bls_replica = None
         if bls_keys is not None:
             from ..bls.factory import create_bls_bft_replica
-            from ..common.constants import POOL_LEDGER_ID
             from ..common.messages.internal_messages import RaisedSuspicion
             from ..utils.base58 import b58encode
 
@@ -236,6 +236,19 @@ class Node:
             network=self.external_bus, ordering_service=self.ordering,
             view_change_service=self.view_changer,
             propagator=self.propagator)
+
+        # --- pool membership from the pool ledger ------------------------
+        from .pool_manager import PoolManager
+
+        self.pool_manager = PoolManager(
+            name, self.data,
+            bls_key_register=(self.bls_replica.key_register
+                              if self.bls_replica else None),
+            on_membership_changed=self._on_membership_changed)
+        self.pool_manager.init_from_ledger(
+            self.boot.db.get_ledger(POOL_LEDGER_ID))
+        # composition hook: transports / vote planes react to membership
+        self.on_membership_changed_hook: Optional[Callable] = None
 
         # --- catchup ----------------------------------------------------
         from ..common.messages.internal_messages import RaisedSuspicion
@@ -417,6 +430,28 @@ class Node:
     def _on_backup_ordered(self, inst_id: int, ordered: Ordered) -> None:
         self.monitor.requests_ordered(inst_id, list(ordered.reqIdr))
 
+    def _on_membership_changed(self, validators: List[str],
+                               registry: Dict[str, dict]) -> None:
+        """A committed NODE txn changed the validator set: quorums and the
+        BLS register are already updated (PoolManager); the composition
+        reacts to the rest (transport connects, vote-plane axis)."""
+        primary = self.data.primary_name
+        if primary is not None and primary not in validators:
+            # the master primary was demoted: it must not keep minting
+            # batches the pool accepts — vote it out now (reference:
+            # plenum starts a view change when the primary leaves the set)
+            from ..common.messages.internal_messages import (
+                VoteForViewChange,
+            )
+            from .suspicion_codes import Suspicions
+
+            logger.info("%s: primary %s demoted -> vote view change",
+                        self.name, primary)
+            self.internal_bus.send(VoteForViewChange(
+                suspicion=Suspicions.PRIMARY_DEMOTED))
+        if self.on_membership_changed_hook is not None:
+            self.on_membership_changed_hook(validators, registry)
+
     def _on_view_change_started(self, msg, *args) -> None:
         # backups' votes are void in the new view; they rebuild at finish
         self.replicas.teardown()
@@ -454,6 +489,9 @@ class Node:
         for offset, digest in enumerate(valid):
             seq_no = first_seq + offset
             txn = ledger.get_by_seq_no(seq_no)
+            if staged.ledger_id == POOL_LEDGER_ID:
+                # membership authority: committed NODE txns reconfigure
+                self.pool_manager.process_committed_txn(txn)
             req = self.propagator.get(digest)
             payload_digest = req.payload_digest if req is not None else digest
             self.req_idr_to_txn.add(
@@ -471,6 +509,10 @@ class Node:
     def _on_catchup_finished(self, msg: CatchupFinished, *args) -> None:
         self.executed_upto = max(self.executed_upto,
                                  msg.last_caught_up_3pc[1])
+        # txns fetched during catchup bypassed the execution hook; the
+        # pool ledger may carry membership changes we haven't absorbed
+        self.pool_manager.refresh_from_ledger(
+            self.boot.db.get_ledger(POOL_LEDGER_ID))
 
     # ------------------------------------------------------------------
 

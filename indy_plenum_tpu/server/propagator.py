@@ -26,7 +26,6 @@ from ..common.event_bus import ExternalBus
 from ..common.messages.node_messages import Propagate
 from ..common.request import Request
 from ..common.stashing_router import DISCARD, PROCESS
-from .quorums import Quorums
 
 logger = logging.getLogger(__name__)
 
@@ -73,20 +72,28 @@ class Propagator:
 
     def __init__(self,
                  name: str,
-                 quorums: Quorums,
+                 quorums,
                  network: ExternalBus,
                  on_finalised: Callable[[Request], None],
                  on_needs_auth: Optional[Callable[[Request], None]] = None,
                  is_already_committed: Optional[
-                     Callable[[Request], bool]] = None):
+                     Callable[[Request], bool]] = None,
+                 is_validator: Optional[Callable[[str], bool]] = None):
         self._name = name
-        self._quorums = quorums
+        # a Quorums object or a zero-arg provider returning the CURRENT
+        # one — membership changes replace the node's Quorums instance,
+        # and finalisation must follow the live f+1 threshold
+        self._quorums = (quorums if callable(quorums)
+                         else (lambda: quorums))
         self._network = network
         self._on_finalised = on_finalised
         # replay floor: once a request executes, its propagator state is
         # GC'd — late-arriving PROPAGATEs must not recreate it and
         # re-finalise the same request into a fresh batch
         self._is_already_committed = is_already_committed or (lambda r: False)
+        # only CURRENT validators' propagates count toward f+1 (a demoted
+        # node keeps its transport identity but loses its vote)
+        self._is_validator = is_validator or (lambda s: True)
         # a relayed request we have NOT authenticated must pass through the
         # node's (device-batched) auth pipeline before we add our own vote:
         # relaying blindly would let f byzantine propagates + our echo
@@ -122,7 +129,9 @@ class Propagator:
             return DISCARD, f"malformed PROPAGATE: {exc}"
         if self._is_already_committed(request):
             return DISCARD, "request already committed"
-        state = self.requests.add_propagate(request, sender)
+        state = self.requests.add(request)
+        if self._is_validator(sender):
+            state.propagates.add(sender)
         if state.sender_client is None and msg.senderClient:
             state.sender_client = msg.senderClient
         # relay: our own vote is what lets the pool converge when only one
@@ -143,7 +152,7 @@ class Propagator:
     def _try_finalise(self, state: ReqState) -> None:
         if state.finalised:
             return
-        if self._quorums.propagate.is_reached(len(state.propagates)):
+        if self._quorums().propagate.is_reached(len(state.propagates)):
             state.finalised = True
             logger.debug("%s finalised request %s (%d propagates)",
                          self._name, state.request.digest,

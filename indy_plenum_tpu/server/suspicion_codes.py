@@ -41,6 +41,8 @@ class Suspicions:
     CHK_DIGEST_WRONG = Suspicion(24, "CHECKPOINT digest mismatch at stable")
     PRIMARY_DEGRADED = Suspicion(
         25, "master primary degraded (throughput/latency vs backups)")
+    PRIMARY_DEMOTED = Suspicion(
+        26, "master primary left the validator set (NODE txn demotion)")
     SEQ_NO_OLD = Suspicion(30, "3PC message below watermark")
     SEQ_NO_FUTURE = Suspicion(31, "3PC message above watermark")
     CATCHUP_REP_WRONG = Suspicion(40, "CATCHUP_REP txns fail audit proof")

@@ -28,7 +28,8 @@ class NodePool:
                  config: Optional[Config] = None,
                  device_quorum: bool = False,
                  bls: bool = False,
-                 num_instances: int = 1):
+                 num_instances: int = 1,
+                 with_pool_genesis: bool = False):
         # num_instances: 1 = master only; 0 = auto f+1 (full RBFT)
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
@@ -41,6 +42,29 @@ class NodePool:
         domain_genesis = [genesis_nym_txn(
             self.trustee.identifier, self.trustee.verkey, role=TRUSTEE)]
         seed_keys = {self.trustee.identifier: self.trustee.verkey}
+
+        # pool genesis: one NODE txn per initial validator, owned by one
+        # steward each (membership-from-ledger mode; the PoolManager takes
+        # over the validator registry)
+        self.stewards: Dict[str, DidSigner] = {}
+        self.pool_genesis = None
+        self._domain_genesis = domain_genesis
+        self._seed_keys = seed_keys
+        if with_pool_genesis:
+            from ..common.constants import STEWARD
+            from ..ledger.genesis import genesis_node_txn
+
+            self.pool_genesis = []
+            for i, name in enumerate(self.validators):
+                steward = DidSigner(hashlib.sha256(
+                    b"pool-steward-%d" % i).digest())
+                self.stewards[name] = steward
+                domain_genesis.append(genesis_nym_txn(
+                    steward.identifier, steward.verkey, role=STEWARD))
+                self.pool_genesis.append(genesis_node_txn(
+                    node_nym=f"nym-{name}", alias=name,
+                    steward_did=steward.identifier,
+                    node_port=9700 + 2 * i, client_port=9701 + 2 * i))
 
         self.bls_keys = None
         if bls:
@@ -64,6 +88,8 @@ class NodePool:
             node = Node(
                 name, self.validators, self.timer, self.network,
                 config=self.config, domain_genesis=domain_genesis,
+                pool_genesis=([dict(t) for t in self.pool_genesis]
+                              if self.pool_genesis else None),
                 seed_keys=dict(seed_keys), bls_keys=self.bls_keys,
                 vote_plane=plane, num_instances=num_instances,
                 drive_quorum_ticks=False)  # the pool drives group ticks
@@ -76,6 +102,28 @@ class NodePool:
             self.timer, self.config, self.vote_group, self.nodes)
 
         self._req_seq = 0
+
+    def add_node(self, name: str) -> Node:
+        """Spin up a validator that the pool has ALREADY admitted via a
+        committed NODE txn; it bootstraps from genesis and catches up the
+        ledgers (including the NODE txn that admitted it)."""
+        validators = list(self.nodes[0].data.validators)
+        assert name in validators, f"{name} not in the committed membership"
+        node = Node(
+            name, validators, self.timer, self.network,
+            config=self.config,
+            domain_genesis=[dict(t) for t in self._domain_genesis],
+            pool_genesis=([dict(t) for t in self.pool_genesis]
+                          if self.pool_genesis else None),
+            seed_keys=dict(self._seed_keys),
+            num_instances=1, drive_quorum_ticks=False)
+        self.nodes.append(node)
+        if name not in self.validators:
+            self.validators.append(name)
+        self.network.connect_all()
+        node.start()
+        node.leecher.start()  # fetch everything committed before we joined
+        return node
 
     # ------------------------------------------------------------------
 

@@ -130,13 +130,12 @@ def test_get_txn_returns_txn_with_verifiable_audit_path():
                    operation={TXN_TYPE: GET_TXN,
                               "ledgerId": DOMAIN_LEDGER_ID,
                               "data": seq_no})
-    client.submit_read(read, to="node3")
+    client.submit_read(read)  # no proof surface -> broadcast, f+1 quorum
     pool.pump_client(client)
-    # GET_TXN replies have no state_proof: collected as a normal reply
     state = client.pending[read.digest]
-    assert state.replies, "no GET_TXN reply"
-    result = next(iter(state.replies.values()))
-    assert result["data"] is not None
+    assert len(state.replies) >= 2, "GET_TXN must gather an f+1 quorum"
+    result = client.result(read.digest)
+    assert result is not None and result["data"] is not None
     proof = result["auditProof"]
     # client-side: the txn bytes are bound to the ledger root
     v = MerkleVerifier()
@@ -150,10 +149,19 @@ def test_get_txn_returns_txn_with_verifiable_audit_path():
     read2 = Request(identifier="reader", reqId=103,
                     operation={TXN_TYPE: GET_TXN,
                                "ledgerId": DOMAIN_LEDGER_ID, "data": 999})
-    client.submit_read(read2, to="node3")
+    client.submit_read(read2)
     pool.pump_client(client)
-    assert next(iter(
-        client.pending[read2.digest].replies.values()))["data"] is None
+    assert client.result(read2.digest)["data"] is None
+
+    # a SINGLE (potentially forged) GET_TXN reply is never enough
+    lone = Request(identifier="reader", reqId=106,
+                   operation={TXN_TYPE: GET_TXN,
+                              "ledgerId": DOMAIN_LEDGER_ID, "data": seq_no})
+    d = client.submit_read(lone)
+    client._process_reply("node0", {"identifier": "reader", "reqId": 106,
+                                    "data": {"forged": True},
+                                    "type": GET_TXN})
+    assert client.result(d) is None  # one reply < f+1
 
 
 def test_bad_read_request_nacked():

@@ -56,21 +56,36 @@ class PendingRequest:
 class Client:
     def __init__(self,
                  name: str,
-                 validators: List[str],
+                 validators,
                  send: Callable[[Request, str, str], Any],
-                 pool_bls_keys: Optional[Dict[str, str]] = None,
+                 pool_bls_keys=None,
                  now_provider: Callable[[], float] = time.time,
                  proof_max_age: float = DEFAULT_PROOF_MAX_AGE):
+        """``validators`` and ``pool_bls_keys`` may be values OR zero-arg
+        providers: with dynamic membership (NODE txns) the client must
+        verify against the CURRENT pool, not its construction-time view."""
         self.name = name
-        self._validators = list(validators)
+        self._validators_src = validators
         self._send = send
-        self._pool_bls_keys = dict(pool_bls_keys or {})
+        self._bls_keys_src = pool_bls_keys or {}
         self._now = now_provider
         self._proof_max_age = proof_max_age
-        n = len(self._validators)
-        self._f = (n - 1) // 3
         self.pending: Dict[str, PendingRequest] = {}  # digest -> state
         self.proved_reads: Dict[str, dict] = {}  # digest -> verified result
+
+    @property
+    def _validators(self) -> List[str]:
+        src = self._validators_src
+        return list(src() if callable(src) else src)
+
+    @property
+    def _pool_bls_keys(self) -> Dict[str, str]:
+        src = self._bls_keys_src
+        return dict(src() if callable(src) else src)
+
+    @property
+    def _f(self) -> int:
+        return (len(self._validators) - 1) // 3
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,126 @@
+"""Metrics: named event accumulators + timing around the hot paths.
+
+Reference: plenum/common/metrics_collector.py (`MetricsCollector`,
+`KvStoreMetricsCollector`, ``measure_time``/``async_measure_time``). Every
+event is (name, value); the collector keeps running stats per name
+(count/sum/min/max) cheap enough for the consensus hot path, and the KV
+variant persists periodic snapshots so a long-running node's history
+survives restarts.
+
+The names cover what the device-plane design must be able to justify with
+data: device flush counts and latencies, auth batch sizes and durations,
+3PC batch timings.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class MetricsName:
+    # ingress
+    AUTH_BATCH_SIZE = "auth.batch_size"
+    AUTH_BATCH_TIME = "auth.batch_time"
+    # 3PC
+    BACKUP_ORDERED = "3pc.backup_ordered"
+    ORDERED_BATCH_SIZE = "3pc.ordered_batch_size"
+    # device plane
+    DEVICE_FLUSH = "device.flush"
+    DEVICE_FLUSH_TIME = "device.flush_time"
+    DEVICE_FLUSH_VOTES = "device.flush_votes"
+    # execution
+    COMMIT_TIME = "exec.commit_time"
+
+
+class Stat:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"count": self.count, "sum": self.total, "avg": self.avg,
+                "min": self.min, "max": self.max}
+
+
+class MetricsCollector:
+    def __init__(self):
+        self._stats: Dict[str, Stat] = {}
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        stat = self._stats.get(name)
+        if stat is None:
+            stat = self._stats[name] = Stat()
+        stat.add(value)
+
+    def stat(self, name: str) -> Optional[Stat]:
+        return self._stats.get(name)
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        return {name: s.as_dict() for name, s in sorted(self._stats.items())}
+
+    @contextmanager
+    def measure_time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_event(name, time.perf_counter() - t0)
+
+
+class NullMetricsCollector(MetricsCollector):
+    """Zero-cost sink for compositions that don't collect."""
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        pass
+
+    @contextmanager
+    def measure_time(self, name: str):
+        yield
+
+
+class KvMetricsCollector(MetricsCollector):
+    """Persists summary snapshots into a KV store (reference: the
+    KvStoreMetricsCollector's accumulated storage)."""
+
+    def __init__(self, store, flush_every: int = 1000):
+        super().__init__()
+        self._store = store
+        self._flush_every = flush_every
+        self._events_since_flush = 0
+
+    def add_event(self, name: str, value: float = 1.0) -> None:
+        super().add_event(name, value)
+        self._events_since_flush += 1
+        if self._events_since_flush >= self._flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        import json
+
+        self._events_since_flush = 0
+        for name, stat in self._stats.items():
+            self._store.put(name.encode(),
+                            json.dumps(stat.as_dict()).encode())
+
+    def load_persisted(self) -> Dict[str, Dict[str, Any]]:
+        import json
+
+        out = {}
+        for key, value in self._store.iterator():
+            out[bytes(key).decode()] = json.loads(bytes(value))
+        return out

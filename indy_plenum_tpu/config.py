@@ -39,8 +39,10 @@ class Config:
     # --- freshness --------------------------------------------------------
     # idle pools re-sign their state roots periodically (an empty 3PC
     # batch): without this, proved reads go stale once writes stop
-    # (reference: STATE_FRESHNESS_UPDATE_INTERVAL)
-    StateFreshnessUpdateInterval: float = 300.0  # 0 disables
+    # (reference: STATE_FRESHNESS_UPDATE_INTERVAL). Must sit WELL below
+    # the client's proof max age (300s) so reads arriving just before a
+    # freshness batch still verify.
+    StateFreshnessUpdateInterval: float = 120.0  # 0 disables
 
     # --- view change ------------------------------------------------------
     ToleratePrimaryDisconnection: float = 2.0  # seconds

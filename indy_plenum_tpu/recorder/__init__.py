@@ -1,0 +1,4 @@
+"""Message recording + deterministic replay (reference: plenum/recorder/)."""
+from .recorder import Recorder, Replayer
+
+__all__ = ["Recorder", "Replayer"]

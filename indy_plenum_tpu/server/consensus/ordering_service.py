@@ -452,18 +452,26 @@ class OrderingService:
                 and self._requests is not None:
             committed = self._executor.committed_seq()
             floor = max(committed, self._last_applied_seq)
-            if pp.ppSeqNo > committed and pp.ppSeqNo != floor + 1:
+            historical = pp.ppSeqNo <= committed
+            if not historical and pp.ppSeqNo != floor + 1:
                 return STASH_WAITING_PREV_PP, (
                     f"out-of-order apply: {pp.ppSeqNo} after {floor}")
             reqs = [self._requests.get(d) for d in pp.reqIdr]
             state_root, txn_root = self._executor.apply_batch(
                 reqs, pp.ledgerId, pp.ppTime, pp.ppSeqNo)
+            # a HISTORICAL batch (<= committed: post-view-change re-order
+            # of something already executed) stages nothing — the roots
+            # come from the audit ledger, and on mismatch there is nothing
+            # of ours to revert (reverting would pop an unrelated
+            # genuinely-staged batch and corrupt the uncommitted roots)
             if state_root != pp.stateRootHash:
-                self._executor.revert_batches(pp.ledgerId, 1)
+                if not historical:
+                    self._executor.revert_batches(pp.ledgerId, 1)
                 self._raise_suspicion(sender, Suspicions.PPR_STATE_WRONG)
                 return DISCARD, "state root mismatch"
             if txn_root != pp.txnRootHash:
-                self._executor.revert_batches(pp.ledgerId, 1)
+                if not historical:
+                    self._executor.revert_batches(pp.ledgerId, 1)
                 self._raise_suspicion(sender, Suspicions.PPR_TXN_WRONG)
                 return DISCARD, "txn root mismatch"
             # the rejection split is deterministic: a primary lying about

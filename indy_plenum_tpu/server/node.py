@@ -129,10 +129,16 @@ class Node:
                  bls_keys=None,
                  vote_plane=None,
                  drive_quorum_ticks: bool = True,
-                 num_instances: int = 1):
+                 num_instances: int = 1,
+                 metrics=None):
         self.name = name
         self.config = config or getConfig()
         self.timer = timer
+        from ..common.metrics_collector import MetricsCollector
+
+        # injectable: pass a NullMetricsCollector to disable collection,
+        # or a shared collector to aggregate across components
+        self.metrics = metrics if metrics is not None else MetricsCollector()
         # f+1 protocol instances (RBFT): instance i's primary is offset i
         # in the round-robin; only the master (inst 0) executes
         if num_instances <= 0:
@@ -278,7 +284,8 @@ class Node:
         # backup pools are bounded drop-oldest: a stalled backup primary
         # must read as a SLOW instance, not as unbounded node memory
         self.replicas = Replicas(
-            name, validators, timer, self.external_bus, self.config,
+            name, lambda: self.data.validators, timer, self.external_bus,
+            self.config,
             make_requests_pool=lambda: NodeRequestsPool(
                 self.propagator,
                 classify=self.boot.write_manager.ledger_id_for_request,
@@ -396,8 +403,12 @@ class Node:
         """ONE device batch authenticates everything queued this tick."""
         if not self._auth_queue:
             return
+        from ..common.metrics_collector import MetricsName
+
         batch, self._auth_queue = self._auth_queue, []
-        verdicts = self.authnr.authenticate_batch(batch)
+        self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
+        with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
+            verdicts = self.authnr.authenticate_batch(batch)
         for req, ok in zip(batch, verdicts):
             client = self._req_clients.get(req.digest)
             if not ok:
@@ -428,6 +439,10 @@ class Node:
         self.replicas.enqueue_finalised(request)
 
     def _on_backup_ordered(self, inst_id: int, ordered: Ordered) -> None:
+        from ..common.metrics_collector import MetricsName
+
+        self.metrics.add_event(MetricsName.BACKUP_ORDERED,
+                               len(ordered.reqIdr))
         self.monitor.requests_ordered(inst_id, list(ordered.reqIdr))
 
     def _on_membership_changed(self, validators: List[str],
@@ -449,6 +464,11 @@ class Node:
                         self.name, primary)
             self.internal_bus.send(VoteForViewChange(
                 suspicion=Suspicions.PRIMARY_DEMOTED))
+        if self.num_instances > 1 and self.replicas.backups:
+            # live backup instances still hold the old validator set (and
+            # would discard the new member's votes) — rebuild them now
+            self.replicas.build(self.data.view_no, self.data.primaries)
+            self.monitor.reset(self.num_instances)
         if self.on_membership_changed_hook is not None:
             self.on_membership_changed_hook(validators, registry)
 
@@ -480,7 +500,12 @@ class Node:
             return  # already executed (re-ordered after view change)
         self.executed_upto = ordered.ppSeqNo
         self.ordered_log.append(ordered)
-        staged = self.executor.commit_batch(ordered.ppSeqNo)
+        from ..common.metrics_collector import MetricsName
+
+        self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
+                               len(ordered.reqIdr))
+        with self.metrics.measure_time(MetricsName.COMMIT_TIME):
+            staged = self.executor.commit_batch(ordered.ppSeqNo)
         if staged is None:
             return
         ledger = self.boot.db.get_ledger(staged.ledger_id)

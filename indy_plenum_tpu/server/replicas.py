@@ -103,7 +103,10 @@ class Replicas:
                  forward_request_propagates: Optional[Callable] = None,
                  num_instances: Optional[int] = None):
         self._node_name = node_name
-        self._validators = validators
+        # a list, or a zero-arg provider of the CURRENT validator set —
+        # rebuilt backups must see live membership, not the boot-time list
+        self._validators = (validators if callable(validators)
+                            else (lambda: validators))
         self._timer = timer
         self._external_bus = external_bus
         self._config = config
@@ -112,8 +115,9 @@ class Replicas:
         self._forward_request_propagates = forward_request_propagates
         # instance count the NODE was sized for (monitor slots, primaries
         # list length) — not re-derived here, or the two could disagree
-        self._num_instances = (num_instances if num_instances is not None
-                               else config.replicas_count(len(validators)))
+        self._num_instances = (
+            num_instances if num_instances is not None
+            else config.replicas_count(len(self._validators())))
         self.backups: List[BackupReplica] = []
 
     @property
@@ -121,11 +125,11 @@ class Replicas:
         return self._num_instances
 
     def build(self, view_no: int, primaries: List[str]) -> None:
-        """(Re)create backups for ``view_no``."""
+        """(Re)create backups for ``view_no`` with CURRENT membership."""
         self.teardown()
         for inst_id in range(1, self._num_instances):
             replica = BackupReplica(
-                self._node_name, self._validators, inst_id, view_no,
+                self._node_name, self._validators(), inst_id, view_no,
                 primaries, self._timer, self._external_bus, self._config,
                 requests_pool=self._make_requests_pool(),
                 on_ordered=lambda o, i=inst_id: self._on_backup_ordered(i, o),

@@ -162,15 +162,27 @@ class NodePool:
         """A pool client wired to the sim nodes (direct-call transport)."""
         from ..client.client import Client
 
-        pool_bls_keys = {}
+        static_bls = {}
         if self.bls_keys is not None:
-            pool_bls_keys = {n: pk
-                             for n, (kp, pk, pop) in self.bls_keys.items()}
+            static_bls = {n: pk
+                          for n, (kp, pk, pop) in self.bls_keys.items()}
+
+        def live_bls_keys():
+            # static sim keys + any keys the pool registry carries (a
+            # node admitted by NODE txn brings its BLS key through it)
+            from ..common.constants import BLS_KEY
+
+            out = dict(static_bls)
+            for alias, rec in self.nodes[0].pool_manager.registry.items():
+                if rec.get(BLS_KEY):
+                    out[alias] = rec[BLS_KEY]
+            return out
+
         return Client(
-            name, self.validators,
+            name, lambda: list(self.nodes[0].data.validators),
             send=lambda req, node, cid: self.node(node)
             .submit_client_request(req, client_id=cid),
-            pool_bls_keys=pool_bls_keys,
+            pool_bls_keys=live_bls_keys,
             now_provider=self.timer.get_current_time)
 
     def pump_client(self, client) -> None:

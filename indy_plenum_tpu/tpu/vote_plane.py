@@ -290,7 +290,7 @@ class VotePlaneGroup:
     """
 
     def __init__(self, n_members: int, validators: List[str], log_size: int,
-                 n_checkpoints: int = 4, h: int = 0):
+                 n_checkpoints: int = 4, h: int = 0, metrics=None):
         self._n = len(validators)
         self._log_size = log_size
         self._n_chk = n_checkpoints
@@ -306,36 +306,53 @@ class VotePlaneGroup:
         self._host_commit_counts: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
         self.flushes = 0
+        # device placement must be justifiable with data: flush count,
+        # latency and votes-per-flush land here (injectable for a shared
+        # or null collector)
+        from ..common.metrics_collector import MetricsCollector
+
+        self.metrics = metrics if metrics is not None else MetricsCollector()
 
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
 
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
+        from ..common.metrics_collector import MetricsName
+
         if (not any(m._pending for m in self._members)
                 and self._host_prepared is not None):
             return
-        stepped = False
-        while any(m._pending for m in self._members):
-            chunks = []
-            for m in self._members:
-                take, m._pending = (m._pending[:FLUSH_BATCH],
-                                    m._pending[FLUSH_BATCH:])
-                chunks.append(take)
-            msgs = _pack_group_messages(chunks, FLUSH_BATCH)
-            self._states, events = _group_step(self._states, msgs, self._n)
-            self.flushes += 1
-            stepped = True
-        if not stepped:  # cold start: no votes recorded anywhere yet
-            msgs = _pack_group_messages(
-                [[] for _ in self._members], FLUSH_BATCH)
-            self._states, events = _group_step(self._states, msgs, self._n)
-            self.flushes += 1
-        self._host_prepared = np.asarray(events.prepared)
-        self._host_prepare_counts = np.asarray(events.prepare_counts)
-        self._host_commit_counts = np.asarray(events.commit_counts)
-        self._host_stable = np.asarray(events.stable_checkpoints)
-        self.version += 1
+        with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
+            stepped = False
+            while any(m._pending for m in self._members):
+                chunks = []
+                votes = 0
+                for m in self._members:
+                    take, m._pending = (m._pending[:FLUSH_BATCH],
+                                        m._pending[FLUSH_BATCH:])
+                    chunks.append(take)
+                    votes += len(take)
+                msgs = _pack_group_messages(chunks, FLUSH_BATCH)
+                self._states, events = _group_step(
+                    self._states, msgs, self._n)
+                self.flushes += 1
+                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+                self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES,
+                                       votes)
+                stepped = True
+            if not stepped:  # cold start: no votes recorded anywhere yet
+                msgs = _pack_group_messages(
+                    [[] for _ in self._members], FLUSH_BATCH)
+                self._states, events = _group_step(
+                    self._states, msgs, self._n)
+                self.flushes += 1
+                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+            self._host_prepared = np.asarray(events.prepared)
+            self._host_prepare_counts = np.asarray(events.prepare_counts)
+            self._host_commit_counts = np.asarray(events.commit_counts)
+            self._host_stable = np.asarray(events.stable_checkpoints)
+            self.version += 1
 
     def slide_member(self, member_idx: int, delta: int) -> None:
         self.flush()

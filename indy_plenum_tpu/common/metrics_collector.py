@@ -95,13 +95,22 @@ class NullMetricsCollector(MetricsCollector):
 
 class KvMetricsCollector(MetricsCollector):
     """Persists summary snapshots into a KV store (reference: the
-    KvStoreMetricsCollector's accumulated storage)."""
+    KvStoreMetricsCollector's accumulated storage). Re-opening over a
+    non-empty store SEEDS the counters from the persisted snapshot, so
+    history genuinely survives restarts instead of being overwritten by
+    the new process's counters."""
 
     def __init__(self, store, flush_every: int = 1000):
         super().__init__()
         self._store = store
         self._flush_every = flush_every
         self._events_since_flush = 0
+        for name, snap in self.load_persisted().items():
+            stat = self._stats[name] = Stat()
+            stat.count = snap.get("count", 0)
+            stat.total = snap.get("sum", 0.0)
+            stat.min = snap.get("min")
+            stat.max = snap.get("max")
 
     def add_event(self, name: str, value: float = 1.0) -> None:
         super().add_event(name, value)

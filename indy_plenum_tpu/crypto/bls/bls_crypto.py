@@ -19,7 +19,22 @@ from typing import Dict, List, Optional, Sequence
 
 from ...utils.base58 import b58decode, b58encode
 from . import bn254 as bn
-from . import bn254_fast as fast
+
+# backend ladder: native C (the analog of the reference's Rust backend)
+# -> projective pure-Python -> both pinned against the affine oracle
+try:
+    from . import bn254_native as fast
+
+    NATIVE_BACKEND = True
+except Exception as _native_err:  # pragma: no cover — no compiler/headers
+    import logging as _logging
+
+    _logging.getLogger(__name__).warning(
+        "native BN254 backend unavailable (%s); using pure-Python "
+        "projective path", _native_err)
+    from . import bn254_fast as fast  # type: ignore[no-redef]
+
+    NATIVE_BACKEND = False
 
 # --- point serialization (wire: base58 of fixed-width big-endian) ---------
 

@@ -39,6 +39,7 @@ from ..common.messages.node_messages import (
     RequestNack,
 )
 from ..common.exceptions import InvalidClientRequest
+from ..common.metrics_collector import MetricsCollector, MetricsName
 from ..common.request import Request
 from ..common.stashing_router import StashingRouter
 from ..common.txn_util import get_from, get_req_id
@@ -134,8 +135,6 @@ class Node:
         self.name = name
         self.config = config or getConfig()
         self.timer = timer
-        from ..common.metrics_collector import MetricsCollector
-
         # injectable: pass a NullMetricsCollector to disable collection,
         # or a shared collector to aggregate across components
         self.metrics = metrics if metrics is not None else MetricsCollector()
@@ -403,8 +402,6 @@ class Node:
         """ONE device batch authenticates everything queued this tick."""
         if not self._auth_queue:
             return
-        from ..common.metrics_collector import MetricsName
-
         batch, self._auth_queue = self._auth_queue, []
         self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
         with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
@@ -439,8 +436,6 @@ class Node:
         self.replicas.enqueue_finalised(request)
 
     def _on_backup_ordered(self, inst_id: int, ordered: Ordered) -> None:
-        from ..common.metrics_collector import MetricsName
-
         self.metrics.add_event(MetricsName.BACKUP_ORDERED,
                                len(ordered.reqIdr))
         self.monitor.requests_ordered(inst_id, list(ordered.reqIdr))
@@ -500,8 +495,6 @@ class Node:
             return  # already executed (re-ordered after view change)
         self.executed_upto = ordered.ppSeqNo
         self.ordered_log.append(ordered)
-        from ..common.metrics_collector import MetricsName
-
         self.metrics.add_event(MetricsName.ORDERED_BATCH_SIZE,
                                len(ordered.reqIdr))
         with self.metrics.measure_time(MetricsName.COMMIT_TIME):

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common.metrics_collector import MetricsCollector, MetricsName
 from . import quorum as q
 
 # fixed flush granularity: stable shapes keep XLA from recompiling
@@ -309,8 +310,6 @@ class VotePlaneGroup:
         # device placement must be justifiable with data: flush count,
         # latency and votes-per-flush land here (injectable for a shared
         # or null collector)
-        from ..common.metrics_collector import MetricsCollector
-
         self.metrics = metrics if metrics is not None else MetricsCollector()
 
     def view(self, member_idx: int) -> "DeviceVotePlane":
@@ -318,8 +317,6 @@ class VotePlaneGroup:
 
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
-        from ..common.metrics_collector import MetricsName
-
         if (not any(m._pending for m in self._members)
                 and self._host_prepared is not None):
             return

@@ -1,0 +1,978 @@
+/* BN254 pairing in C: the native backend for the BLS hot path.
+ *
+ * The reference stack (hyperledger indy-plenum) delegates BLS to a native
+ * Rust library (indy-crypto / ursa, AMCL BN254); this module is the
+ * analogous native backend here.  Same tower and the same projective /
+ * sparse-line formulas as the pure-Python fast path
+ * (indy_plenum_tpu/crypto/bls/bn254_fast.py — derivations documented
+ * there), over 4x64-limb Montgomery arithmetic.  The pure-Python
+ * bn254.py remains the correctness oracle; tests pin this module
+ * against it on scalar muls, pairings and subgroup checks.
+ *
+ * Interface contract (coarse calls; ints cross as 32-byte big-endian):
+ *   g1_mul(xy:bytes64|None, k:bytes32) -> bytes64|None
+ *   g2_mul(xyxy:bytes128|None, k:bytes32) -> bytes128|None
+ *   g1_sum([bytes64,...]) -> bytes64|None
+ *   g2_sum([bytes128,...]) -> bytes128|None
+ *   g2_in_subgroup(bytes128) -> bool
+ *   multi_pairing([(bytes64|None, bytes128|None), ...]) -> bytes384 (Fp12)
+ *   pairing_check([...]) -> bool
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef unsigned __int128 u128;
+typedef struct { uint64_t v[4]; } fp;       /* Montgomery form */
+typedef struct { fp a, b; } fp2;            /* a + b*i, i^2 = -1 */
+typedef struct { fp2 c0, c1, c2; } fp6;     /* Fp2[v]/(v^3 - xi) */
+typedef struct { fp6 a, b; } fp12;          /* Fp6[w]/(w^2 - v) */
+
+/* ---- constants (generated; see repo notes) --------------------------- */
+static const fp FP_P   = {{0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                           0xb85045b68181585dULL, 0x30644e72e131a029ULL}};
+static const fp FP_R1  = {{0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                           0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL}};
+static const fp FP_R2  = {{0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
+                           0x47ab1eff0a417ff6ULL, 0x06d89f71cab8351fULL}};
+static const uint64_t N0INV = 0x87d20782e4866389ULL;
+/* BN parameter u and the ate loop count 6u+2 */
+static const uint64_t BN_U = 0x44e992b44a6909f1ULL;
+/* 6u+2 = 0x19d797039be763ba8 (65 bits) */
+static const uint64_t ATE_LO = 0x9d797039be763ba8ULL;
+static const int ATE_BITS = 65; /* including leading 1 bit */
+
+/* ---- fp -------------------------------------------------------------- */
+
+static inline int fp_is_zero(const fp *a) {
+    return (a->v[0] | a->v[1] | a->v[2] | a->v[3]) == 0;
+}
+static inline int fp_eq(const fp *a, const fp *b) {
+    return a->v[0] == b->v[0] && a->v[1] == b->v[1]
+        && a->v[2] == b->v[2] && a->v[3] == b->v[3];
+}
+static inline int fp_gte_p(const fp *a) {
+    for (int i = 3; i >= 0; i--) {
+        if (a->v[i] > FP_P.v[i]) return 1;
+        if (a->v[i] < FP_P.v[i]) return 0;
+    }
+    return 1;
+}
+static inline void fp_sub_p(fp *a) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a->v[i] - FP_P.v[i] - (uint64_t)borrow;
+        a->v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;  /* 1 if borrowed */
+    }
+}
+static inline void fp_add(fp *r, const fp *a, const fp *b) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 s = (u128)a->v[i] + b->v[i] + (uint64_t)carry;
+        r->v[i] = (uint64_t)s;
+        carry = s >> 64;
+    }
+    if (carry || fp_gte_p(r)) fp_sub_p(r);
+}
+static inline void fp_sub(fp *r, const fp *a, const fp *b) {
+    u128 borrow = 0;
+    fp t;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a->v[i] - b->v[i] - (uint64_t)borrow;
+        t.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+    if (borrow) { /* add P back */
+        u128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 s = (u128)t.v[i] + FP_P.v[i] + (uint64_t)carry;
+            t.v[i] = (uint64_t)s;
+            carry = s >> 64;
+        }
+    }
+    *r = t;
+}
+static inline void fp_neg(fp *r, const fp *a) {
+    if (fp_is_zero(a)) { *r = *a; return; }
+    fp zero = {{0, 0, 0, 0}};
+    fp_sub(r, &zero, a);
+}
+
+/* Montgomery multiplication, CIOS */
+static void fp_mul(fp *r, const fp *a, const fp *b) {
+    uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 s = (u128)a->v[j] * b->v[i] + t[j] + (uint64_t)carry;
+            t[j] = (uint64_t)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[4] + (uint64_t)carry;
+        t[4] = (uint64_t)s;
+        t[5] = (uint64_t)(s >> 64);
+        uint64_t m = t[0] * N0INV;
+        carry = 0;
+        u128 s0 = (u128)m * FP_P.v[0] + t[0];
+        carry = s0 >> 64;
+        for (int j = 1; j < 4; j++) {
+            u128 sj = (u128)m * FP_P.v[j] + t[j] + (uint64_t)carry;
+            t[j - 1] = (uint64_t)sj;
+            carry = sj >> 64;
+        }
+        u128 s4 = (u128)t[4] + (uint64_t)carry;
+        t[3] = (uint64_t)s4;
+        t[4] = t[5] + (uint64_t)(s4 >> 64);
+    }
+    fp out = {{t[0], t[1], t[2], t[3]}};
+    if (t[4] || fp_gte_p(&out)) fp_sub_p(&out);
+    *r = out;
+}
+static inline void fp_sqr(fp *r, const fp *a) { fp_mul(r, a, a); }
+
+static void fp_from_bytes_be(fp *r, const unsigned char *be32) {
+    fp raw;
+    for (int i = 0; i < 4; i++) {
+        uint64_t w = 0;
+        for (int j = 0; j < 8; j++)
+            w = (w << 8) | be32[(3 - i) * 8 + j];
+        raw.v[i] = w;
+    }
+    fp_mul(r, &raw, &FP_R2); /* to Montgomery */
+}
+static void fp_to_bytes_be(unsigned char *be32, const fp *a) {
+    fp one = {{1, 0, 0, 0}}, std_;
+    fp_mul(&std_, a, &one); /* from Montgomery */
+    for (int i = 0; i < 4; i++) {
+        uint64_t w = std_.v[i];
+        for (int j = 7; j >= 0; j--) {
+            be32[(3 - i) * 8 + j] = (unsigned char)(w & 0xFF);
+            w >>= 8;
+        }
+    }
+}
+/* pow(a, P-2): inversion (exponent fixed) */
+static void fp_inv(fp *r, const fp *a) {
+    fp e = FP_P;
+    /* exponent = P - 2 */
+    u128 borrow = 2;
+    for (int i = 0; i < 4 && borrow; i++) {
+        u128 d = (u128)e.v[i] - (uint64_t)borrow;
+        e.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+    fp out = FP_R1, base = *a;
+    for (int i = 0; i < 4; i++) {
+        uint64_t bits = e.v[i];
+        for (int j = 0; j < 64; j++) {
+            if (bits & 1) fp_mul(&out, &out, &base);
+            fp_sqr(&base, &base);
+            bits >>= 1;
+        }
+    }
+    *r = out;
+}
+static inline void fp_set_small(fp *r, uint64_t x) {
+    fp raw = {{x, 0, 0, 0}};
+    fp_mul(r, &raw, &FP_R2);
+}
+
+/* ---- fp2 ------------------------------------------------------------- */
+
+static inline void f2_add(fp2 *r, const fp2 *x, const fp2 *y) {
+    fp_add(&r->a, &x->a, &y->a); fp_add(&r->b, &x->b, &y->b);
+}
+static inline void f2_sub(fp2 *r, const fp2 *x, const fp2 *y) {
+    fp_sub(&r->a, &x->a, &y->a); fp_sub(&r->b, &x->b, &y->b);
+}
+static inline void f2_neg(fp2 *r, const fp2 *x) {
+    fp_neg(&r->a, &x->a); fp_neg(&r->b, &x->b);
+}
+static inline void f2_conj(fp2 *r, const fp2 *x) {
+    r->a = x->a; fp_neg(&r->b, &x->b);
+}
+static inline int f2_is_zero(const fp2 *x) {
+    return fp_is_zero(&x->a) && fp_is_zero(&x->b);
+}
+static inline int f2_eq(const fp2 *x, const fp2 *y) {
+    return fp_eq(&x->a, &y->a) && fp_eq(&x->b, &y->b);
+}
+static void f2_mul(fp2 *r, const fp2 *x, const fp2 *y) {
+    fp t0, t1, sa, sb, cross;
+    fp_mul(&t0, &x->a, &y->a);
+    fp_mul(&t1, &x->b, &y->b);
+    fp_add(&sa, &x->a, &x->b);
+    fp_add(&sb, &y->a, &y->b);
+    fp_mul(&cross, &sa, &sb);
+    fp2 out;
+    fp_sub(&out.a, &t0, &t1);
+    fp_sub(&cross, &cross, &t0);
+    fp_sub(&out.b, &cross, &t1);
+    *r = out;
+}
+static inline void f2_sqr(fp2 *r, const fp2 *x) { f2_mul(r, x, x); }
+static void f2_muls(fp2 *r, const fp2 *x, uint64_t s) {
+    fp fs; fp_set_small(&fs, s);
+    fp_mul(&r->a, &x->a, &fs);
+    fp_mul(&r->b, &x->b, &fs);
+}
+/* xi = 9 + i:  (9a - b) + (9b + a) i */
+static void f2_mul_xi(fp2 *r, const fp2 *x) {
+    fp nine; fp_set_small(&nine, 9);
+    fp t9a, t9b;
+    fp_mul(&t9a, &x->a, &nine);
+    fp_mul(&t9b, &x->b, &nine);
+    fp2 out;
+    fp_sub(&out.a, &t9a, &x->b);
+    fp_add(&out.b, &t9b, &x->a);
+    *r = out;
+}
+static void f2_inv(fp2 *r, const fp2 *x) {
+    fp a2, b2, n, ni;
+    fp_sqr(&a2, &x->a);
+    fp_sqr(&b2, &x->b);
+    fp_add(&n, &a2, &b2);
+    fp_inv(&ni, &n);
+    fp_mul(&r->a, &x->a, &ni);
+    fp nb; fp_neg(&nb, &x->b);
+    fp_mul(&r->b, &nb, &ni);
+}
+
+/* ---- fp6 ------------------------------------------------------------- */
+
+static inline void f6_add(fp6 *r, const fp6 *x, const fp6 *y) {
+    f2_add(&r->c0, &x->c0, &y->c0);
+    f2_add(&r->c1, &x->c1, &y->c1);
+    f2_add(&r->c2, &x->c2, &y->c2);
+}
+static inline void f6_sub(fp6 *r, const fp6 *x, const fp6 *y) {
+    f2_sub(&r->c0, &x->c0, &y->c0);
+    f2_sub(&r->c1, &x->c1, &y->c1);
+    f2_sub(&r->c2, &x->c2, &y->c2);
+}
+static inline void f6_neg(fp6 *r, const fp6 *x) {
+    f2_neg(&r->c0, &x->c0); f2_neg(&r->c1, &x->c1); f2_neg(&r->c2, &x->c2);
+}
+/* Karatsuba-style 3-term mul (same structure as the Python tower) */
+static void f6_mul(fp6 *r, const fp6 *x, const fp6 *y) {
+    fp2 t0, t1, t2, s, u, w;
+    f2_mul(&t0, &x->c0, &y->c0);
+    f2_mul(&t1, &x->c1, &y->c1);
+    f2_mul(&t2, &x->c2, &y->c2);
+    fp6 out;
+    /* c0 = t0 + xi*((x1+x2)(y1+y2) - t1 - t2) */
+    f2_add(&s, &x->c1, &x->c2);
+    f2_add(&u, &y->c1, &y->c2);
+    f2_mul(&w, &s, &u);
+    f2_sub(&w, &w, &t1);
+    f2_sub(&w, &w, &t2);
+    f2_mul_xi(&w, &w);
+    f2_add(&out.c0, &t0, &w);
+    /* c1 = (x0+x1)(y0+y1) - t0 - t1 + xi*t2 */
+    f2_add(&s, &x->c0, &x->c1);
+    f2_add(&u, &y->c0, &y->c1);
+    f2_mul(&w, &s, &u);
+    f2_sub(&w, &w, &t0);
+    f2_sub(&w, &w, &t1);
+    fp2 xt2; f2_mul_xi(&xt2, &t2);
+    f2_add(&out.c1, &w, &xt2);
+    /* c2 = (x0+x2)(y0+y2) - t0 - t2 + t1 */
+    f2_add(&s, &x->c0, &x->c2);
+    f2_add(&u, &y->c0, &y->c2);
+    f2_mul(&w, &s, &u);
+    f2_sub(&w, &w, &t0);
+    f2_sub(&w, &w, &t2);
+    f2_add(&out.c2, &w, &t1);
+    *r = out;
+}
+static inline void f6_sqr(fp6 *r, const fp6 *x) { f6_mul(r, x, x); }
+static void f6_mul_v(fp6 *r, const fp6 *x) {
+    /* v*(c0 + c1 v + c2 v^2) = xi*c2 + c0 v + c1 v^2 */
+    fp2 t; f2_mul_xi(&t, &x->c2);
+    fp2 c0 = x->c0, c1 = x->c1;
+    r->c0 = t; r->c1 = c0; r->c2 = c1;
+}
+static void f6_inv(fp6 *r, const fp6 *x) {
+    fp2 c0, c1, c2, t, u;
+    f2_sqr(&c0, &x->c0);
+    f2_mul(&t, &x->c1, &x->c2); f2_mul_xi(&t, &t);
+    f2_sub(&c0, &c0, &t);
+    f2_sqr(&c1, &x->c2); f2_mul_xi(&c1, &c1);
+    f2_mul(&t, &x->c0, &x->c1);
+    f2_sub(&c1, &c1, &t);
+    f2_sqr(&c2, &x->c1);
+    f2_mul(&t, &x->c0, &x->c2);
+    f2_sub(&c2, &c2, &t);
+    f2_mul(&t, &x->c2, &c1);
+    f2_mul(&u, &x->c1, &c2);
+    f2_add(&t, &t, &u);
+    f2_mul_xi(&t, &t);
+    f2_mul(&u, &x->c0, &c0);
+    f2_add(&t, &t, &u);
+    fp2 ti; f2_inv(&ti, &t);
+    f2_mul(&r->c0, &c0, &ti);
+    f2_mul(&r->c1, &c1, &ti);
+    f2_mul(&r->c2, &c2, &ti);
+}
+
+/* ---- fp12 ------------------------------------------------------------ */
+
+static void f12_mul(fp12 *r, const fp12 *x, const fp12 *y) {
+    fp6 t0, t1, s, u, w;
+    f6_mul(&t0, &x->a, &y->a);
+    f6_mul(&t1, &x->b, &y->b);
+    fp12 out;
+    f6_mul_v(&w, &t1);
+    f6_add(&out.a, &t0, &w);
+    f6_add(&s, &x->a, &x->b);
+    f6_add(&u, &y->a, &y->b);
+    f6_mul(&w, &s, &u);
+    f6_sub(&w, &w, &t0);
+    f6_sub(&out.b, &w, &t1);
+    *r = out;
+}
+static void f12_sqr(fp12 *r, const fp12 *x) { f12_mul(r, x, x); }
+static void f12_conj(fp12 *r, const fp12 *x) {
+    r->a = x->a; f6_neg(&r->b, &x->b);
+}
+static void f12_inv(fp12 *r, const fp12 *x) {
+    fp6 t, u, ti;
+    f6_mul(&t, &x->a, &x->a);
+    f6_mul(&u, &x->b, &x->b);
+    f6_mul_v(&u, &u);
+    f6_sub(&t, &t, &u);
+    f6_inv(&ti, &t);
+    f6_mul(&r->a, &x->a, &ti);
+    fp6 nb; f6_neg(&nb, &x->b);
+    f6_mul(&r->b, &nb, &ti);
+}
+static void f12_one(fp12 *r) {
+    memset(r, 0, sizeof *r);
+    r->a.c0.a = FP_R1;
+}
+static int f12_is_one(const fp12 *x) {
+    fp12 one; f12_one(&one);
+    return memcmp(x, &one, sizeof one) == 0;
+}
+
+/* Frobenius gamma constants, standard (non-Montgomery) hex; converted at
+ * module init. gamma[j] = XI^((p-1)j/6), j = 1..5. */
+static const char *G1C_HEX[6][2] = {
+    {NULL, NULL},
+    {"1284b71c2865a7dfe8b99fdd76e68b605c521e08292f2176d60b35dadcc9e470",
+     "246996f3b4fae7e6a6327cfe12150b8e747992778eeec7e5ca5cf05f80f362ac"},
+    {"2fb347984f7911f74c0bec3cf559b143b78cc310c2c3330c99e39557176f553d",
+     "16c9e55061ebae204ba4cc8bd75a079432ae2a1d0b7c9dce1665d51c640fcba2"},
+    {"063cf305489af5dcdc5ec698b6e2f9b9dbaae0eda9c95998dc54014671a0135a",
+     "07c03cbcac41049a0704b5a7ec796f2b21807dc98fa25bd282d37f632623b0e3"},
+    {"05b54f5e64eea80180f3c0b75a181e84d33365f7be94ec72848a1f55921ea762",
+     "2c145edbe7fd8aee9f3a80b03b0b1c923685d2ea1bdec763c13b4711cd2b8126"},
+    {"0183c1e74f798649e93a3661a4353ff4425c459b55aa1bd32ea2c810eab7692f",
+     "12acf2ca76fd0675a27fb246c7729f7db080cb99678e2ac024c6b8ee6e0c2c4b"},
+};
+static const char *B_TWIST_HEX[2] = {
+    "2b149d40ceb8aaae81be18991be06ac3b5b4c5e559dbefa33267e6dc24a138e5",
+    "009713b03af0fed4cd2cafadeed8fdf4a74fa084e52d1852e4a2bd0685c315d2"};
+static fp2 G1C[6];
+static fp2 B_TWIST;
+
+static void fp_from_hex(fp *r, const char *hex) {
+    unsigned char be[32];
+    for (int i = 0; i < 32; i++) {
+        unsigned hi, lo;
+        sscanf(hex + 2 * i, "%1x", &hi);
+        sscanf(hex + 2 * i + 1, "%1x", &lo);
+        be[i] = (unsigned char)((hi << 4) | lo);
+    }
+    fp_from_bytes_be(r, be);
+}
+
+static void f12_frobenius(fp12 *r, const fp12 *x) {
+    fp2 t;
+    fp12 out;
+    f2_conj(&out.a.c0, &x->a.c0);
+    f2_conj(&t, &x->a.c1); f2_mul(&out.a.c1, &t, &G1C[2]);
+    f2_conj(&t, &x->a.c2); f2_mul(&out.a.c2, &t, &G1C[4]);
+    f2_conj(&t, &x->b.c0); f2_mul(&out.b.c0, &t, &G1C[1]);
+    f2_conj(&t, &x->b.c1); f2_mul(&out.b.c1, &t, &G1C[3]);
+    f2_conj(&t, &x->b.c2); f2_mul(&out.b.c2, &t, &G1C[5]);
+    *r = out;
+}
+/* pow by the 63-bit BN u (square-and-multiply, MSB first) */
+static void f12_pow_u(fp12 *r, const fp12 *x) {
+    fp12 out = *x;
+    for (int i = 61; i >= 0; i--) {   /* BN_U is 63 bits: bit62 is MSB */
+        f12_sqr(&out, &out);
+        if ((BN_U >> i) & 1) f12_mul(&out, &out, x);
+    }
+    *r = out;
+}
+
+/* final exponentiation: easy part then the DSD vector chain (mirrors the
+ * oracle bn254.py:_hard, itself pinned against a generic exponentiation) */
+static void final_exp(fp12 *r, const fp12 *f) {
+    fp12 f1, f2i, m, t;
+    f12_conj(&f1, f);
+    f12_inv(&f2i, f);
+    f12_mul(&m, &f1, &f2i);             /* f^(p^6 - 1) */
+    f12_frobenius(&t, &m);
+    f12_frobenius(&t, &t);
+    f12_mul(&m, &t, &m);                /* ^(p^2 + 1) */
+
+    fp12 fu1, fu2, fu3, fp1, fp2_, fp3;
+    f12_pow_u(&fu1, &m);
+    f12_pow_u(&fu2, &fu1);
+    f12_pow_u(&fu3, &fu2);
+    f12_frobenius(&fp1, &m);
+    f12_frobenius(&fp2_, &fp1);
+    f12_frobenius(&fp3, &fp2_);
+    fp12 y0, y1, y2, y3, y4, y5, y6, t0, t1, u;
+    f12_mul(&y0, &fp1, &fp2_); f12_mul(&y0, &y0, &fp3);
+    f12_conj(&y1, &m);
+    f12_frobenius(&y2, &fu2); f12_frobenius(&y2, &y2);
+    f12_frobenius(&y3, &fu1); f12_conj(&y3, &y3);
+    f12_frobenius(&u, &fu2); f12_mul(&u, &fu1, &u); f12_conj(&y4, &u);
+    f12_conj(&y5, &fu2);
+    f12_frobenius(&u, &fu3); f12_mul(&u, &fu3, &u); f12_conj(&y6, &u);
+    f12_sqr(&t0, &y6);
+    f12_mul(&t0, &t0, &y4); f12_mul(&t0, &t0, &y5);
+    f12_mul(&t1, &y3, &y5); f12_mul(&t1, &t1, &t0);
+    f12_mul(&t0, &t0, &y2);
+    f12_sqr(&t1, &t1); f12_mul(&t1, &t1, &t0);
+    f12_sqr(&t1, &t1);
+    f12_mul(&t0, &t1, &y1);
+    f12_mul(&t1, &t1, &y0);
+    f12_sqr(&t0, &t0);
+    f12_mul(r, &t0, &t1);
+}
+
+/* ---- G1 jacobian ------------------------------------------------------ */
+
+typedef struct { fp x, y, z; } g1j;
+
+static void g1j_double(g1j *r, const g1j *p) {
+    if (fp_is_zero(&p->y)) { memset(r, 0, sizeof *r); r->y = FP_R1; return; }
+    fp y2, s, m, x3, y3, z3, t;
+    fp_sqr(&y2, &p->y);
+    fp_mul(&s, &p->x, &y2);
+    fp_add(&s, &s, &s); fp_add(&s, &s, &s);        /* 4 X Y^2 */
+    fp_sqr(&m, &p->x);
+    fp_add(&t, &m, &m); fp_add(&m, &t, &m);        /* 3 X^2 */
+    fp_sqr(&x3, &m);
+    fp_add(&t, &s, &s);
+    fp_sub(&x3, &x3, &t);                          /* M^2 - 2S */
+    fp_sqr(&t, &y2);
+    fp_add(&t, &t, &t); fp_add(&t, &t, &t); fp_add(&t, &t, &t); /* 8Y^4 */
+    fp_sub(&y3, &s, &x3);
+    fp_mul(&y3, &m, &y3);
+    fp_sub(&y3, &y3, &t);
+    fp_mul(&z3, &p->y, &p->z);
+    fp_add(&z3, &z3, &z3);
+    r->x = x3; r->y = y3; r->z = z3;
+}
+static void g1j_add_affine(g1j *r, const g1j *p, const fp *x2, const fp *y2) {
+    if (fp_is_zero(&p->z)) { r->x = *x2; r->y = *y2; r->z = FP_R1; return; }
+    fp z2, u2, s2, h, rr, h2, h3, xh2, t;
+    fp_sqr(&z2, &p->z);
+    fp_mul(&u2, x2, &z2);
+    fp_mul(&s2, y2, &z2); fp_mul(&s2, &s2, &p->z);
+    fp_sub(&h, &u2, &p->x);
+    fp_sub(&rr, &s2, &p->y);
+    if (fp_is_zero(&h)) {
+        if (fp_is_zero(&rr)) { g1j_double(r, p); return; }
+        memset(r, 0, sizeof *r); r->y = FP_R1; return;
+    }
+    fp_sqr(&h2, &h);
+    fp_mul(&h3, &h, &h2);
+    fp_mul(&xh2, &p->x, &h2);
+    fp_sqr(&t, &rr);
+    fp_sub(&t, &t, &h3);
+    fp x3; fp_add(&x3, &xh2, &xh2);
+    fp_sub(&x3, &t, &x3);
+    fp y3; fp_sub(&y3, &xh2, &x3);
+    fp_mul(&y3, &rr, &y3);
+    fp_mul(&t, &p->y, &h3);
+    fp_sub(&y3, &y3, &t);
+    fp z3; fp_mul(&z3, &p->z, &h);
+    r->x = x3; r->y = y3; r->z = z3;
+}
+static void g1j_to_affine(fp *x, fp *y, int *is_inf, const g1j *p) {
+    if (fp_is_zero(&p->z)) { *is_inf = 1; return; }
+    *is_inf = 0;
+    fp zi, zi2;
+    fp_inv(&zi, &p->z);
+    fp_sqr(&zi2, &zi);
+    fp_mul(x, &p->x, &zi2);
+    fp_mul(y, &p->y, &zi2);
+    fp_mul(y, y, &zi);
+}
+
+/* ---- G2 jacobian over fp2 --------------------------------------------- */
+
+typedef struct { fp2 x, y, z; } g2j;
+
+static void g2j_set_inf(g2j *r) {
+    memset(r, 0, sizeof *r);
+    r->y.a = FP_R1;
+}
+static void g2j_double(g2j *r, const g2j *p) {
+    if (f2_is_zero(&p->y)) { g2j_set_inf(r); return; }
+    fp2 y2, s, m, x3, y3, z3, t;
+    f2_sqr(&y2, &p->y);
+    f2_mul(&s, &p->x, &y2);
+    f2_muls(&s, &s, 4);
+    f2_sqr(&m, &p->x);
+    f2_muls(&m, &m, 3);
+    f2_sqr(&x3, &m);
+    f2_add(&t, &s, &s);
+    f2_sub(&x3, &x3, &t);
+    f2_sqr(&t, &y2);
+    f2_muls(&t, &t, 8);
+    f2_sub(&y3, &s, &x3);
+    f2_mul(&y3, &m, &y3);
+    f2_sub(&y3, &y3, &t);
+    f2_mul(&z3, &p->y, &p->z);
+    f2_add(&z3, &z3, &z3);
+    r->x = x3; r->y = y3; r->z = z3;
+}
+static void g2j_add_affine(g2j *r, const g2j *p, const fp2 *x2,
+                           const fp2 *y2) {
+    if (f2_is_zero(&p->z)) { r->x = *x2; r->y = *y2;
+        memset(&r->z, 0, sizeof r->z); r->z.a = FP_R1; return; }
+    fp2 z2, u2, s2, h, rr, h2, h3, xh2, t, x3, y3, z3;
+    f2_sqr(&z2, &p->z);
+    f2_mul(&u2, x2, &z2);
+    f2_mul(&s2, y2, &z2); f2_mul(&s2, &s2, &p->z);
+    f2_sub(&h, &u2, &p->x);
+    f2_sub(&rr, &s2, &p->y);
+    if (f2_is_zero(&h)) {
+        if (f2_is_zero(&rr)) { g2j_double(r, p); return; }
+        g2j_set_inf(r); return;
+    }
+    f2_sqr(&h2, &h);
+    f2_mul(&h3, &h, &h2);
+    f2_mul(&xh2, &p->x, &h2);
+    f2_sqr(&t, &rr);
+    f2_sub(&t, &t, &h3);
+    f2_add(&x3, &xh2, &xh2);
+    f2_sub(&x3, &t, &x3);
+    f2_sub(&y3, &xh2, &x3);
+    f2_mul(&y3, &rr, &y3);
+    f2_mul(&t, &p->y, &h3);
+    f2_sub(&y3, &y3, &t);
+    f2_mul(&z3, &p->z, &h);
+    r->x = x3; r->y = y3; r->z = z3;
+}
+static void g2j_to_affine(fp2 *x, fp2 *y, int *is_inf, const g2j *p) {
+    if (f2_is_zero(&p->z)) { *is_inf = 1; return; }
+    *is_inf = 0;
+    fp2 zi, zi2;
+    f2_inv(&zi, &p->z);
+    f2_sqr(&zi2, &zi);
+    f2_mul(x, &p->x, &zi2);
+    f2_mul(y, &p->y, &zi2);
+    f2_mul(y, y, &zi);
+}
+
+/* ---- Miller loop (projective twist; same derivation as bn254_fast) ---- */
+
+typedef struct { fp2 x, y, z; } tw; /* fractional: x = X/Z, y = Y/Z */
+
+/* sparse f * (c0 + c1 w + c3 w^3), c0 scaled by yp (fp), c1 by xp (fp) */
+static void sparse6(fp6 *r, const fp6 *x, const fp2 *e0, const fp2 *e1) {
+    /* (x0,x1,x2) * (e0,e1,0) */
+    fp2 t, u;
+    f2_mul(&t, &x->c2, e1); f2_mul_xi(&t, &t);
+    f2_mul(&u, &x->c0, e0);
+    f2_add(&r->c0, &u, &t);
+    f2_mul(&t, &x->c0, e1);
+    f2_mul(&u, &x->c1, e0);
+    f2_add(&r->c1, &t, &u);
+    f2_mul(&t, &x->c1, e1);
+    f2_mul(&u, &x->c2, e0);
+    f2_add(&r->c2, &t, &u);
+}
+static void f12_sparse013(fp12 *f, const fp2 *c0, const fp2 *c1,
+                          const fp2 *c3) {
+    fp6 t0, t1, s, cross, la_lb0;
+    /* t0 = a * (c0,0,0) = scalar */
+    f2_mul(&t0.c0, &f->a.c0, c0);
+    f2_mul(&t0.c1, &f->a.c1, c0);
+    f2_mul(&t0.c2, &f->a.c2, c0);
+    sparse6(&t1, &f->b, c1, c3);
+    f6_add(&s, &f->a, &f->b);
+    fp2 e0; f2_add(&e0, c0, c1);
+    sparse6(&cross, &s, &e0, c3);
+    f6_mul_v(&la_lb0, &t1);
+    fp12 out;
+    f6_add(&out.a, &t0, &la_lb0);
+    f6_sub(&cross, &cross, &t0);
+    f6_sub(&out.b, &cross, &t1);
+    *f = out;
+}
+
+static void dbl_step(tw *t, fp2 *c0, fp2 *c1, fp2 *c3,
+                     const fp *xp, const fp *yp) {
+    fp2 X2, X4, Y2, Z2, YZ, XY2Z, u, w;
+    f2_sqr(&X2, &t->x);
+    f2_sqr(&X4, &X2);
+    f2_sqr(&Y2, &t->y);
+    f2_sqr(&Z2, &t->z);
+    f2_mul(&YZ, &t->y, &t->z);
+    f2_mul(&XY2Z, &t->x, &Y2); f2_mul(&XY2Z, &XY2Z, &t->z);
+    /* c0 = 2 Y Z^2 yp ; c1 = -3 X^2 Z xp ; c3 = X^3 - 2 b' Z^3 */
+    f2_mul(&u, &t->y, &Z2);
+    f2_add(&u, &u, &u);
+    c0->a.v[0] = 0; /* will overwrite */
+    fp2 scaled;
+    fp_mul(&scaled.a, &u.a, yp); fp_mul(&scaled.b, &u.b, yp);
+    *c0 = scaled;
+    f2_mul(&u, &X2, &t->z);
+    f2_muls(&u, &u, 3);
+    f2_neg(&u, &u);
+    fp_mul(&scaled.a, &u.a, xp); fp_mul(&scaled.b, &u.b, xp);
+    *c1 = scaled;
+    f2_mul(&u, &t->x, &X2);
+    f2_mul(&w, &t->z, &Z2);
+    f2_mul(&w, &B_TWIST, &w);
+    f2_add(&w, &w, &w);
+    f2_sub(c3, &u, &w);
+    /* X3 = 2YZ(9X^4 - 8XY^2Z); Y3 = 36 X^3 Y^2 Z - 27 X^6 - 8 Y^4 Z^2;
+       Z3 = 8 (YZ)^3 */
+    fp2 nx, ny, nz;
+    f2_muls(&u, &X4, 9);
+    f2_muls(&w, &XY2Z, 8);
+    f2_sub(&u, &u, &w);
+    f2_mul(&nx, &YZ, &u);
+    f2_add(&nx, &nx, &nx);
+    fp2 x3cu; f2_mul(&x3cu, &t->x, &X2);           /* X^3 */
+    f2_mul(&u, &x3cu, &Y2); f2_mul(&u, &u, &t->z); /* X^3 Y^2 Z */
+    f2_muls(&u, &u, 36);
+    f2_mul(&w, &X2, &X4);                          /* X^6 */
+    f2_muls(&w, &w, 27);
+    f2_sub(&u, &u, &w);
+    f2_sqr(&w, &Y2); f2_mul(&w, &w, &Z2);          /* Y^4 Z^2 */
+    f2_muls(&w, &w, 8);
+    f2_sub(&ny, &u, &w);
+    f2_mul(&u, &Y2, &Z2);
+    f2_mul(&nz, &YZ, &u);
+    f2_muls(&nz, &nz, 8);
+    t->x = nx; t->y = ny; t->z = nz;
+}
+static int add_step(tw *t, fp2 *c0, fp2 *c1, fp2 *c3,
+                    const fp2 *x2, const fp2 *y2,
+                    const fp *xp, const fp *yp) {
+    fp2 x2Z, A, B, A2, B2, B3, A2Z, u, w, scaled;
+    f2_mul(&x2Z, x2, &t->z);
+    f2_mul(&A, y2, &t->z);
+    f2_sub(&A, &A, &t->y);
+    f2_sub(&B, &x2Z, &t->x);
+    if (f2_is_zero(&B)) return 0; /* degenerate: caller falls back */
+    f2_sqr(&A2, &A);
+    f2_sqr(&B2, &B);
+    f2_mul(&B3, &B, &B2);
+    f2_mul(&A2Z, &A2, &t->z);
+    /* line: c0 = B yp ; c1 = -A xp ; c3 = A x2 - B y2 */
+    fp_mul(&scaled.a, &B.a, yp); fp_mul(&scaled.b, &B.b, yp);
+    *c0 = scaled;
+    f2_neg(&u, &A);
+    fp_mul(&scaled.a, &u.a, xp); fp_mul(&scaled.b, &u.b, xp);
+    *c1 = scaled;
+    f2_mul(&u, &A, x2);
+    f2_mul(&w, &B, y2);
+    f2_sub(c3, &u, &w);
+    /* X3 = B (A^2 Z - (X + x2 Z) B^2);
+       Y3 = A ((2 x2 Z + X) B^2 - A^2 Z) - y2 B^3 Z; Z3 = B^3 Z */
+    fp2 nx, ny, nz;
+    f2_add(&u, &t->x, &x2Z);
+    f2_mul(&u, &u, &B2);
+    f2_sub(&u, &A2Z, &u);
+    f2_mul(&nx, &B, &u);
+    f2_add(&u, &x2Z, &x2Z);
+    f2_add(&u, &u, &t->x);
+    f2_mul(&u, &u, &B2);
+    f2_sub(&u, &u, &A2Z);
+    f2_mul(&u, &A, &u);
+    f2_mul(&w, &B3, &t->z);
+    f2_mul(&w, y2, &w);
+    f2_sub(&ny, &u, &w);
+    f2_mul(&nz, &B3, &t->z);
+    t->x = nx; t->y = ny; t->z = nz;
+    return 1;
+}
+
+/* pi on the twist: (x, y) -> (conj(x) G1C2, conj(y) G1C3) */
+static void frob_twist(fp2 *rx, fp2 *ry, const fp2 *x, const fp2 *y) {
+    fp2 t;
+    f2_conj(&t, x); f2_mul(rx, &t, &G1C[2]);
+    f2_conj(&t, y); f2_mul(ry, &t, &G1C[3]);
+}
+
+static int miller(fp12 *f, const fp2 *qx, const fp2 *qy,
+                  const fp *xp, const fp *yp) {
+    tw T = {*qx, *qy, {{{0}}, {{0}}}};
+    T.z.a = FP_R1; /* Z = 1 */
+    memset(&T.z.b, 0, sizeof T.z.b);
+    f12_one(f);
+    fp2 c0, c1, c3;
+    for (int i = ATE_BITS - 2; i >= 0; i--) {
+        dbl_step(&T, &c0, &c1, &c3, xp, yp);
+        f12_sqr(f, f);
+        f12_sparse013(f, &c0, &c1, &c3);
+        if ((ATE_LO >> i) & 1) {
+            if (!add_step(&T, &c0, &c1, &c3, qx, qy, xp, yp)) return 0;
+            f12_sparse013(f, &c0, &c1, &c3);
+        }
+    }
+    fp2 q1x, q1y, q2x, q2y;
+    frob_twist(&q1x, &q1y, qx, qy);
+    frob_twist(&q2x, &q2y, &q1x, &q1y);
+    f2_neg(&q2y, &q2y);
+    if (!add_step(&T, &c0, &c1, &c3, &q1x, &q1y, xp, yp)) return 0;
+    f12_sparse013(f, &c0, &c1, &c3);
+    if (!add_step(&T, &c0, &c1, &c3, &q2x, &q2y, xp, yp)) return 0;
+    f12_sparse013(f, &c0, &c1, &c3);
+    return 1;
+}
+
+/* ---- Python glue ------------------------------------------------------ */
+
+static int parse_fp_be(fp *r, const unsigned char *buf) {
+    fp_from_bytes_be(r, buf);
+    return 1;
+}
+static int parse_g1(fp *x, fp *y, int *is_inf, PyObject *obj) {
+    if (obj == Py_None) { *is_inf = 1; return 1; }
+    char *buf; Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return 0;
+    if (len != 64) { PyErr_SetString(PyExc_ValueError, "G1 needs 64 bytes");
+        return 0; }
+    *is_inf = 0;
+    parse_fp_be(x, (unsigned char *)buf);
+    parse_fp_be(y, (unsigned char *)buf + 32);
+    return 1;
+}
+static int parse_g2(fp2 *x, fp2 *y, int *is_inf, PyObject *obj) {
+    if (obj == Py_None) { *is_inf = 1; return 1; }
+    char *buf; Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return 0;
+    if (len != 128) { PyErr_SetString(PyExc_ValueError, "G2 needs 128 bytes");
+        return 0; }
+    *is_inf = 0;
+    parse_fp_be(&x->a, (unsigned char *)buf);
+    parse_fp_be(&x->b, (unsigned char *)buf + 32);
+    parse_fp_be(&y->a, (unsigned char *)buf + 64);
+    parse_fp_be(&y->b, (unsigned char *)buf + 96);
+    return 1;
+}
+static PyObject *g1_to_py(const g1j *p) {
+    fp x, y; int inf;
+    g1j_to_affine(&x, &y, &inf, p);
+    if (inf) Py_RETURN_NONE;
+    unsigned char out[64];
+    fp_to_bytes_be(out, &x);
+    fp_to_bytes_be(out + 32, &y);
+    return PyBytes_FromStringAndSize((char *)out, 64);
+}
+static PyObject *g2_to_py(const g2j *p) {
+    fp2 x, y; int inf;
+    g2j_to_affine(&x, &y, &inf, p);
+    if (inf) Py_RETURN_NONE;
+    unsigned char out[128];
+    fp_to_bytes_be(out, &x.a);
+    fp_to_bytes_be(out + 32, &x.b);
+    fp_to_bytes_be(out + 64, &y.a);
+    fp_to_bytes_be(out + 96, &y.b);
+    return PyBytes_FromStringAndSize((char *)out, 128);
+}
+static int parse_scalar_bits(unsigned char *be32, PyObject *obj) {
+    char *buf; Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return 0;
+    if (len != 32) { PyErr_SetString(PyExc_ValueError,
+                                     "scalar needs 32 bytes"); return 0; }
+    memcpy(be32, buf, 32);
+    return 1;
+}
+
+static PyObject *py_g1_mul(PyObject *self, PyObject *args) {
+    PyObject *pt, *kobj;
+    if (!PyArg_ParseTuple(args, "OO", &pt, &kobj)) return NULL;
+    fp x, y; int inf;
+    unsigned char k[32];
+    if (!parse_g1(&x, &y, &inf, pt) || !parse_scalar_bits(k, kobj))
+        return NULL;
+    g1j acc; memset(&acc, 0, sizeof acc); acc.y = FP_R1;
+    if (!inf) {
+        for (int i = 0; i < 32; i++) {
+            unsigned char byte = k[i];
+            for (int b = 7; b >= 0; b--) {
+                g1j_double(&acc, &acc);
+                if ((byte >> b) & 1) g1j_add_affine(&acc, &acc, &x, &y);
+            }
+        }
+    }
+    return g1_to_py(&acc);
+}
+static PyObject *py_g2_mul(PyObject *self, PyObject *args) {
+    PyObject *pt, *kobj;
+    if (!PyArg_ParseTuple(args, "OO", &pt, &kobj)) return NULL;
+    fp2 x, y; int inf;
+    unsigned char k[32];
+    if (!parse_g2(&x, &y, &inf, pt) || !parse_scalar_bits(k, kobj))
+        return NULL;
+    g2j acc; g2j_set_inf(&acc);
+    if (!inf) {
+        for (int i = 0; i < 32; i++) {
+            unsigned char byte = k[i];
+            for (int b = 7; b >= 0; b--) {
+                g2j_double(&acc, &acc);
+                if ((byte >> b) & 1) g2j_add_affine(&acc, &acc, &x, &y);
+            }
+        }
+    }
+    return g2_to_py(&acc);
+}
+static PyObject *py_g1_sum(PyObject *self, PyObject *args) {
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "O", &seq)) return NULL;
+    PyObject *it = PyObject_GetIter(seq);
+    if (!it) return NULL;
+    g1j acc; memset(&acc, 0, sizeof acc); acc.y = FP_R1;
+    PyObject *item;
+    while ((item = PyIter_Next(it)) != NULL) {
+        fp x, y; int inf;
+        int ok = parse_g1(&x, &y, &inf, item);
+        Py_DECREF(item);
+        if (!ok) { Py_DECREF(it); return NULL; }
+        if (!inf) g1j_add_affine(&acc, &acc, &x, &y);
+    }
+    Py_DECREF(it);
+    if (PyErr_Occurred()) return NULL;
+    return g1_to_py(&acc);
+}
+static PyObject *py_g2_sum(PyObject *self, PyObject *args) {
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "O", &seq)) return NULL;
+    PyObject *it = PyObject_GetIter(seq);
+    if (!it) return NULL;
+    g2j acc; g2j_set_inf(&acc);
+    PyObject *item;
+    while ((item = PyIter_Next(it)) != NULL) {
+        fp2 x, y; int inf;
+        int ok = parse_g2(&x, &y, &inf, item);
+        Py_DECREF(item);
+        if (!ok) { Py_DECREF(it); return NULL; }
+        if (!inf) g2j_add_affine(&acc, &acc, &x, &y);
+    }
+    Py_DECREF(it);
+    if (PyErr_Occurred()) return NULL;
+    return g2_to_py(&acc);
+}
+/* [R]Q ladder over the group order (unreduced by construction: R's bits) */
+static const unsigned char R_BE[32] = {
+    0x30, 0x64, 0x4e, 0x72, 0xe1, 0x31, 0xa0, 0x29,
+    0xb8, 0x50, 0x45, 0xb6, 0x81, 0x81, 0x58, 0x5d,
+    0x28, 0x33, 0xe8, 0x48, 0x79, 0xb9, 0x70, 0x91,
+    0x43, 0xe1, 0xf5, 0x93, 0xf0, 0x00, 0x00, 0x01};
+static PyObject *py_g2_in_subgroup(PyObject *self, PyObject *args) {
+    PyObject *pt;
+    if (!PyArg_ParseTuple(args, "O", &pt)) return NULL;
+    fp2 x, y; int inf;
+    if (!parse_g2(&x, &y, &inf, pt)) return NULL;
+    if (inf) Py_RETURN_TRUE;
+    g2j acc; g2j_set_inf(&acc);
+    for (int i = 0; i < 32; i++) {
+        unsigned char byte = R_BE[i];
+        for (int b = 7; b >= 0; b--) {
+            g2j_double(&acc, &acc);
+            if ((byte >> b) & 1) g2j_add_affine(&acc, &acc, &x, &y);
+        }
+    }
+    if (f2_is_zero(&acc.z)) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static int accumulate_pairs(fp12 *f, PyObject *pairs) {
+    f12_one(f);
+    PyObject *it = PyObject_GetIter(pairs);
+    if (!it) return 0;
+    PyObject *item;
+    while ((item = PyIter_Next(it)) != NULL) {
+        PyObject *pobj, *qobj;
+        if (!PyArg_ParseTuple(item, "OO", &pobj, &qobj)) {
+            Py_DECREF(item); Py_DECREF(it); return 0;
+        }
+        fp px, py_; int pinf;
+        fp2 qx, qy; int qinf;
+        int ok = parse_g1(&px, &py_, &pinf, pobj)
+              && parse_g2(&qx, &qy, &qinf, qobj);
+        Py_DECREF(item);
+        if (!ok) { Py_DECREF(it); return 0; }
+        if (pinf || qinf) continue;
+        fp12 ml;
+        if (!miller(&ml, &qx, &qy, &px, &py_)) {
+            Py_DECREF(it);
+            PyErr_SetString(PyExc_ArithmeticError,
+                            "degenerate point in miller loop");
+            return 0;
+        }
+        f12_mul(f, f, &ml);
+    }
+    Py_DECREF(it);
+    if (PyErr_Occurred()) return 0;
+    return 1;
+}
+static PyObject *py_multi_pairing(PyObject *self, PyObject *args) {
+    PyObject *pairs;
+    if (!PyArg_ParseTuple(args, "O", &pairs)) return NULL;
+    fp12 f, out;
+    if (!accumulate_pairs(&f, pairs)) return NULL;
+    final_exp(&out, &f);
+    /* 12 x 32 bytes in the Python tuple coefficient order:
+       a.c0.a, a.c0.b, a.c1.a, ... b.c2.b */
+    unsigned char buf[384];
+    const fp *coeffs[12] = {
+        &out.a.c0.a, &out.a.c0.b, &out.a.c1.a, &out.a.c1.b,
+        &out.a.c2.a, &out.a.c2.b, &out.b.c0.a, &out.b.c0.b,
+        &out.b.c1.a, &out.b.c1.b, &out.b.c2.a, &out.b.c2.b};
+    for (int i = 0; i < 12; i++)
+        fp_to_bytes_be(buf + 32 * i, coeffs[i]);
+    return PyBytes_FromStringAndSize((char *)buf, 384);
+}
+static PyObject *py_pairing_check(PyObject *self, PyObject *args) {
+    PyObject *pairs;
+    if (!PyArg_ParseTuple(args, "O", &pairs)) return NULL;
+    fp12 f, out;
+    if (!accumulate_pairs(&f, pairs)) return NULL;
+    final_exp(&out, &f);
+    if (f12_is_one(&out)) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static PyMethodDef Methods[] = {
+    {"g1_mul", py_g1_mul, METH_VARARGS, "G1 scalar mul (bytes64, bytes32)"},
+    {"g2_mul", py_g2_mul, METH_VARARGS, "G2 scalar mul (bytes128, bytes32)"},
+    {"g1_sum", py_g1_sum, METH_VARARGS, "sum of G1 points"},
+    {"g2_sum", py_g2_sum, METH_VARARGS, "sum of G2 points"},
+    {"g2_in_subgroup", py_g2_in_subgroup, METH_VARARGS,
+     "unreduced [R]Q == O check"},
+    {"multi_pairing", py_multi_pairing, METH_VARARGS,
+     "prod e(Pi, Qi) -> 384-byte Fp12"},
+    {"pairing_check", py_pairing_check, METH_VARARGS,
+     "prod e(Pi, Qi) == 1"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "bn254c", "native BN254 pairing", -1, Methods};
+
+PyMODINIT_FUNC PyInit_bn254c(void) {
+    for (int j = 1; j < 6; j++) {
+        fp_from_hex(&G1C[j].a, G1C_HEX[j][0]);
+        fp_from_hex(&G1C[j].b, G1C_HEX[j][1]);
+    }
+    fp_from_hex(&B_TWIST.a, B_TWIST_HEX[0]);
+    fp_from_hex(&B_TWIST.b, B_TWIST_HEX[1]);
+    return PyModule_Create(&moduledef);
+}

@@ -253,6 +253,13 @@ def test_out_of_subgroup_g2_point_rejected():
     # and the real generator still passes
     assert fast.g2_in_subgroup(bn.G2_GEN)
     assert bn.g2_in_subgroup(bn.G2_GEN)
+    try:
+        from indy_plenum_tpu.crypto.bls import bn254_native as nat
+    except Exception:
+        nat = None
+    if nat is not None:  # the native ladder must agree
+        assert not nat.g2_in_subgroup(found)
+        assert nat.g2_in_subgroup(bn.G2_GEN)
 
     # end to end: such a key is rejected by the verifier
     from indy_plenum_tpu.crypto.bls.bls_crypto import (
@@ -262,3 +269,53 @@ def test_out_of_subgroup_g2_point_rejected():
     bad_pk = b58encode(g2_to_bytes(found))
     assert not BlsCryptoVerifier.verify_sig(
         b58encode(b"\x00" * 64), b"msg", bad_pk)
+
+
+# --- native C backend pinned against the oracle ----------------------------
+
+
+def _native():
+    import pytest
+
+    try:
+        from indy_plenum_tpu.crypto.bls import bn254_native as nat
+        return nat
+    except Exception:
+        pytest.skip("native BN254 backend unavailable (no compiler)")
+
+
+def test_native_scalar_muls_match_oracle():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+
+    nat = _native()
+    for k in (0, 1, 2, 3, 17, 2**64 + 3, bn.R - 1, bn.R, bn.R + 7,
+              0x1234567890abcdef1234567890abcdef):
+        assert nat.g1_mul(bn.G1_GEN, k) == bn.g1_mul(bn.G1_GEN, k), k
+        assert nat.g2_mul(bn.G2_GEN, k) == bn.g2_mul(bn.G2_GEN, k), k
+
+
+def test_native_pairing_matches_oracle():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+
+    nat = _native()
+    for a, b in ((12345, 67890), (1, 1), (bn.R - 2, 3)):
+        p = bn.g1_mul(bn.G1_GEN, a)
+        q = bn.g2_mul(bn.G2_GEN, b)
+        assert nat.pairing(q, p) == bn.pairing(q, p), (a, b)
+    p = nat.g1_mul(bn.G1_GEN, 31337)
+    q = nat.g2_mul(bn.G2_GEN, 424242)
+    assert nat.pairing_check([(p, q), (bn.g1_neg(p), q)])
+    assert not nat.pairing_check([(p, q), (p, q)])
+
+
+def test_native_sums_and_subgroup_match_oracle():
+    from indy_plenum_tpu.crypto.bls import bn254 as bn
+    from indy_plenum_tpu.crypto.bls import bn254_fast as fast
+
+    nat = _native()
+    pts1 = [bn.g1_mul(bn.G1_GEN, k) for k in (5, 9, 31, bn.R - 1)]
+    assert nat.g1_sum(pts1) == fast.g1_sum(pts1)
+    pts2 = [bn.g2_mul(bn.G2_GEN, k) for k in (4, 8, 15)]
+    assert nat.g2_sum(pts2) == fast.g2_sum(pts2)
+    assert nat.g2_in_subgroup(bn.G2_GEN)
+    assert nat.g2_in_subgroup(None)

@@ -37,6 +37,11 @@ def test_kv_metrics_collector_persists():
     persisted = KvMetricsCollector(store).load_persisted()
     assert persisted["a"]["count"] == 2
     assert persisted["a"]["sum"] == 4.0
+    # restart: a reopened collector SEEDS from the store and keeps counting
+    reopened = KvMetricsCollector(store, flush_every=1)
+    reopened.add_event("a", 5.0)
+    assert reopened.stat("a").count == 3
+    assert KvMetricsCollector(store).load_persisted()["a"]["count"] == 3
 
 
 def test_node_and_device_plane_emit_metrics():

@@ -96,6 +96,10 @@ class Config:
     METRICS_FLUSH_INTERVAL: float = 10.0
     RECORDER_ENABLED: bool = False
 
+    # --- plugins ----------------------------------------------------------
+    # importable module paths, each exposing plugin_entry(node)
+    PluginModules: Tuple[str, ...] = ()
+
     # --- misc -------------------------------------------------------------
     NETWORK_NAME: str = "sandbox"
     replicas_count_overrider: Optional[int] = None  # else f+1

@@ -32,7 +32,10 @@ _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def _build_and_load():
     src = os.path.abspath(_SRC)
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    so_path = os.path.join(_BUILD_DIR, "bn254c.so")
+    # ABI-tagged artifact name: a .so built by one CPython must never be
+    # loaded into another (segfault or silent pure-Python fallback)
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so_path = os.path.join(_BUILD_DIR, f"bn254c{ext}")
     if (not os.path.exists(so_path)
             or os.path.getmtime(so_path) < os.path.getmtime(src)):
         include = sysconfig.get_paths()["include"]

@@ -1,0 +1,30 @@
+"""Import-and-register plugin loader (reference: plenum's PLUGIN_ROOT)."""
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import Iterable
+
+logger = logging.getLogger(__name__)
+
+ENTRY_POINT = "plugin_entry"
+
+
+def load_plugins(node, modules: Iterable[str]) -> int:
+    """Import each module and call its ``plugin_entry(node)``. Returns the
+    number of plugins loaded; a faulty plugin is logged and skipped (one
+    bad extension must not keep a validator down)."""
+    loaded = 0
+    for name in modules or ():
+        try:
+            mod = importlib.import_module(name)
+            entry = getattr(mod, ENTRY_POINT, None)
+            if entry is None:
+                logger.warning("plugin %s has no %s()", name, ENTRY_POINT)
+                continue
+            entry(node)
+            loaded += 1
+            logger.info("loaded plugin %s", name)
+        except Exception:  # noqa: BLE001 — plugin code is third-party
+            logger.exception("plugin %s failed to load", name)
+    return loaded

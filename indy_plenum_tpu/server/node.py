@@ -322,6 +322,11 @@ class Node:
                 active=False)
         self.vote_plane = vote_plane
 
+        # --- plugins (LAST: entries get a fully constructed node) -------
+        from ..plugins import load_plugins
+
+        load_plugins(self, self.config.PluginModules)
+
     # ------------------------------------------------------------------
 
     def start(self) -> None:

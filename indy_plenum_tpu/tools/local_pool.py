@@ -1,0 +1,152 @@
+"""Provision and run a local validator pool (the CLI's working parts).
+
+Reference: the reference's init utilities + scripts
+(plenum/common/keygen_utils.py, scripts/generate_indy_pool_transactions,
+scripts/start_plenum_node). ``generate_pool_config`` writes a directory a
+human can inspect: per-node seeds, transport keys and addresses, the
+trustee seed, and pool/domain genesis files (one JSON txn per line, the
+reference's format). ``build_node`` reopens that directory and assembles
+one validator over the authenticated ZMQ transport; ``run_pool`` drives
+any number of them on one Looper (in-process pool; production runs one
+process per node with the same pieces).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..common.constants import STEWARD, TRUSTEE
+from ..common.looper import Looper
+from ..config import Config, getConfig
+from ..crypto.signers import DidSigner
+from ..ledger.genesis import (
+    dump_genesis_file,
+    genesis_node_txn,
+    genesis_nym_txn,
+    load_genesis_file,
+)
+from ..network import ZStack, ZStackNetwork, curve_keypair_from_seed
+from ..server.node import Node
+
+POOL_GENESIS = "pool_genesis.jsonl"
+DOMAIN_GENESIS = "domain_genesis.jsonl"
+POOL_INFO = "pool_info.json"  # PUBLIC: addresses + public keys only
+KEYS_DIR = "keys"  # PRIVATE: one secret file per identity — a deployment
+#                    copies pool_info.json to every host but each node's
+#                    keys/<name>.json ONLY to that node's host
+
+
+def generate_pool_config(directory: str, n_nodes: int = 4,
+                         base_port: int = 9700,
+                         master_seed: Optional[bytes] = None) -> Dict:
+    """Write keys + genesis for an n-node pool; returns the pool info.
+
+    ``master_seed`` defaults to fresh randomness (os.urandom) — a fixed
+    seed makes every derived secret publicly recomputable, so it exists
+    only for reproducible test fixtures.
+    """
+    os.makedirs(directory, exist_ok=True)
+    keys_dir = os.path.join(directory, KEYS_DIR)
+    os.makedirs(keys_dir, exist_ok=True)
+    if master_seed is None:
+        master_seed = os.urandom(32)
+
+    def derive(tag: str) -> bytes:
+        return hashlib.sha256(master_seed + tag.encode()).digest()
+
+    trustee = DidSigner(derive("trustee"))
+    domain = [genesis_nym_txn(trustee.identifier, trustee.verkey,
+                              role=TRUSTEE)]
+    pool = []
+    nodes = {}
+    for i in range(n_nodes):
+        name = f"node{i}"
+        steward = DidSigner(derive(f"steward-{i}"))
+        node_seed = derive(f"node-{i}")
+        public, _secret = curve_keypair_from_seed(node_seed)
+        domain.append(genesis_nym_txn(steward.identifier, steward.verkey,
+                                      role=STEWARD))
+        pool.append(genesis_node_txn(
+            node_nym=f"nym-{name}", alias=name,
+            steward_did=steward.identifier,
+            node_port=base_port + 2 * i, client_port=base_port + 2 * i + 1))
+        nodes[name] = {
+            "transport_public": public.decode(),
+            "node_ip": "127.0.0.1",
+            "node_port": base_port + 2 * i,
+        }
+        with open(os.path.join(keys_dir, f"{name}.json"), "w") as fh:
+            json.dump({"seed": node_seed.hex()}, fh)
+    with open(os.path.join(keys_dir, "trustee.json"), "w") as fh:
+        json.dump({"seed": derive("trustee").hex()}, fh)
+    info = {
+        "trustee_did": trustee.identifier,
+        "trustee_verkey": trustee.verkey,
+        "validators": [f"node{i}" for i in range(n_nodes)],
+        "nodes": nodes,
+    }
+    dump_genesis_file(os.path.join(directory, POOL_GENESIS), pool)
+    dump_genesis_file(os.path.join(directory, DOMAIN_GENESIS), domain)
+    with open(os.path.join(directory, POOL_INFO), "w") as fh:
+        json.dump(info, fh, indent=2, sort_keys=True)
+    return info
+
+
+def load_secret_seed(directory: str, name: str) -> bytes:
+    with open(os.path.join(directory, KEYS_DIR, f"{name}.json")) as fh:
+        return bytes.fromhex(json.load(fh)["seed"])
+
+
+def load_pool_info(directory: str) -> Dict:
+    with open(os.path.join(directory, POOL_INFO)) as fh:
+        return json.load(fh)
+
+
+def build_node(directory: str, name: str, looper: Looper,
+               config: Optional[Config] = None) -> Tuple[Node, ZStack]:
+    """Reopen a provisioned directory and assemble one validator."""
+    info = load_pool_info(directory)
+    record = info["nodes"][name]
+    config = config or getConfig(
+        {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 100,
+         "PropagateBatchWait": 0.05})
+    stack = ZStack(name, load_secret_seed(directory, name),
+                   bind_host=record["node_ip"],
+                   bind_port=record["node_port"],
+                   max_batch=config.OUTGOING_BATCH_SIZE,
+                   msg_len_limit=config.MSG_LEN_LIMIT)
+    for peer, rec in info["nodes"].items():
+        if peer == name:
+            continue
+        key = rec["transport_public"].encode()
+        stack.allow_peer(peer, key)
+        stack.connect(peer, (rec["node_ip"], rec["node_port"]), key)
+    net = ZStackNetwork(stack)
+    node = Node(
+        name, list(info["validators"]), looper.timer, net, config=config,
+        pool_genesis=load_genesis_file(
+            os.path.join(directory, POOL_GENESIS)),
+        domain_genesis=load_genesis_file(
+            os.path.join(directory, DOMAIN_GENESIS)),
+        seed_keys={info["trustee_did"]: info["trustee_verkey"]})
+    net.mark_connected(set(info["validators"]) - {name})
+    return node, stack
+
+
+def run_pool(directory: str, names: Optional[List[str]] = None,
+             config: Optional[Config] = None
+             ) -> Tuple[Looper, List[Node], List[ZStack]]:
+    """Assemble + start validators on one Looper (in-process pool)."""
+    info = load_pool_info(directory)
+    names = names or list(info["validators"])
+    looper = Looper()
+    nodes, stacks = [], []
+    for name in names:
+        node, stack = build_node(directory, name, looper, config=config)
+        node.start()
+        looper.add(stack)
+        nodes.append(node)
+        stacks.append(stack)
+    return looper, nodes, stacks

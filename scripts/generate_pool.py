@@ -1,0 +1,35 @@
+#!/usr/bin/env python3
+"""Provision a local validator pool: keys + genesis files.
+
+Usage: python scripts/generate_pool.py DIR [N_NODES] [BASE_PORT] [SEED_HEX]
+(reference analog: scripts/generate_indy_pool_transactions)
+
+Secrets land under DIR/keys/ — copy pool_info.json + genesis to every
+host, but each keys/<node>.json ONLY to that node's host. SEED_HEX (64
+hex chars) makes provisioning reproducible; omit it for fresh randomness.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from indy_plenum_tpu.tools import generate_pool_config  # noqa: E402
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    directory = sys.argv[1]
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    base_port = int(sys.argv[3]) if len(sys.argv) > 3 else 9700
+    seed = bytes.fromhex(sys.argv[4]) if len(sys.argv) > 4 else None
+    info = generate_pool_config(directory, n_nodes=n, base_port=base_port,
+                                master_seed=seed)
+    print(f"pool of {n} validators provisioned in {directory}")
+    for name, rec in sorted(info["nodes"].items()):
+        print(f"  {name}: {rec['node_ip']}:{rec['node_port']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

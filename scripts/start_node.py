@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Run one validator from a provisioned pool directory.
+
+Usage: python scripts/start_node.py DIR NODE_NAME
+(reference analog: scripts/start_plenum_node). Runs the Looper forever;
+^C to stop. One process per validator; peers may live on other hosts as
+long as pool_info.json carries their reachable addresses.
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from indy_plenum_tpu.common.looper import Looper  # noqa: E402
+from indy_plenum_tpu.tools import build_node  # noqa: E402
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__)
+        return 2
+    directory, name = sys.argv[1], sys.argv[2]
+    looper = Looper()
+    node, stack = build_node(directory, name, looper)
+    node.start()
+    looper.add(stack)
+    print(f"{name} listening on {stack.ha[0]}:{stack.ha[1]} — ^C to stop")
+    try:
+        while True:
+            looper.run_for(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+        looper.shutdown()
+        stack.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

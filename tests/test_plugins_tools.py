@@ -1,0 +1,163 @@
+"""Plugin loader + pool provisioning tooling.
+
+Reference: plenum/common/plugin_helper.py (PLUGIN_ROOT loading),
+scripts/generate_indy_pool_transactions + start_plenum_node.
+"""
+import sys
+import types
+
+from indy_plenum_tpu.common.constants import CONFIG_LEDGER_ID, TXN_TYPE
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+CUSTOM_TYPE = "9999"
+
+
+def _install_demo_plugin():
+    """A plugin module registering a write handler for a custom txn type
+    on the config ledger (the same seam the built-in NYM handler uses)."""
+    from indy_plenum_tpu.server.request_handlers.handler_interfaces import (
+        WriteRequestHandler,
+    )
+
+    class KvHandler(WriteRequestHandler):
+        def __init__(self, db):
+            super().__init__(db, CUSTOM_TYPE, CONFIG_LEDGER_ID)
+
+        def static_validation(self, request):
+            self._validate_type(request)
+
+        def dynamic_validation(self, request, req_pp_time):
+            pass
+
+        def update_state(self, txn, prev_result, request=None,
+                         is_committed=False):
+            from indy_plenum_tpu.common.txn_util import get_payload_data
+
+            data = get_payload_data(txn)
+            self.state.set(data["k"].encode(), data["v"].encode())
+
+    mod = types.ModuleType("demo_kv_plugin")
+    mod.plugin_entry = lambda node: \
+        node.boot.write_manager.register_req_handler(
+            KvHandler(node.boot.db))
+    sys.modules["demo_kv_plugin"] = mod
+    return mod
+
+
+def test_plugin_registers_custom_txn_type_end_to_end():
+    _install_demo_plugin()
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.05,
+                        "PluginModules": ("demo_kv_plugin",)})
+    pool = NodePool(4, seed=101, config=config)
+    from indy_plenum_tpu.common.request import Request
+
+    req = Request(identifier=pool.trustee.identifier, reqId=1,
+                  operation={TXN_TYPE: CUSTOM_TYPE, "k": "color",
+                             "v": "amaranth"})
+    pool.trustee.sign_request(req)
+    pool.submit_to("node1", req)
+    pool.run_for(15)
+    for node in pool.nodes:
+        assert len(node.ordered_digests) == 1, node.name
+        state = node.boot.db.get_state(CONFIG_LEDGER_ID)
+        assert state.get(b"color", is_committed=True) == b"amaranth"
+
+
+def test_faulty_plugin_is_isolated():
+    mod = types.ModuleType("exploding_plugin")
+
+    def boom(node):
+        raise RuntimeError("kaboom")
+
+    mod.plugin_entry = boom
+    sys.modules["exploding_plugin"] = mod
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.05,
+                        "PluginModules": ("exploding_plugin",)})
+    pool = NodePool(4, seed=102, config=config)  # must not raise
+    req = pool.make_nym_request()
+    pool.submit_to("node0", req)
+    pool.run_for(15)
+    assert all(len(n.ordered_digests) == 1 for n in pool.nodes)
+
+
+def test_pool_provisioning_roundtrip(tmp_path):
+    """generate -> inspect -> rebuild a node from the directory; the
+    full socket run is covered by test_zstack's end-to-end pool."""
+    import os
+
+    from indy_plenum_tpu.tools import generate_pool_config
+    from indy_plenum_tpu.tools.local_pool import (
+        DOMAIN_GENESIS,
+        POOL_GENESIS,
+        load_pool_info,
+    )
+    from indy_plenum_tpu.ledger.genesis import load_genesis_file
+
+    directory = str(tmp_path / "pool")
+    info = generate_pool_config(directory, n_nodes=4, base_port=0,
+                                master_seed=b"\x07" * 32)
+    assert sorted(info["nodes"]) == [f"node{i}" for i in range(4)]
+    assert load_pool_info(directory)["trustee_did"] == info["trustee_did"]
+    # secrets live OUTSIDE the public pool info (per-host key isolation)
+    assert "seed" not in info["nodes"]["node0"]
+    assert "trustee_seed" not in info
+    from indy_plenum_tpu.tools.local_pool import load_secret_seed
+    assert len(load_secret_seed(directory, "node0")) == 32
+    pool_txns = load_genesis_file(os.path.join(directory, POOL_GENESIS))
+    domain_txns = load_genesis_file(os.path.join(directory, DOMAIN_GENESIS))
+    assert len(pool_txns) == 4
+    assert len(domain_txns) == 5  # trustee + 4 stewards
+    # determinism: same master seed -> identical keys (restartable ops)
+    info2 = generate_pool_config(str(tmp_path / "pool2"), n_nodes=4,
+                                 base_port=0, master_seed=b"\x07" * 32)
+    assert info2["nodes"]["node0"]["transport_public"] == \
+        info["nodes"]["node0"]["transport_public"]
+    # and fresh randomness by default -> different keys
+    info3 = generate_pool_config(str(tmp_path / "pool3"), n_nodes=4,
+                                 base_port=0)
+    assert info3["nodes"]["node0"]["transport_public"] != \
+        info["nodes"]["node0"]["transport_public"]
+
+
+def test_provisioned_pool_orders_over_sockets(tmp_path):
+    """The CLI back-end end-to-end: provision a directory, run the pool
+    from it, submit a signed write, watch it order everywhere."""
+    from indy_plenum_tpu.common.constants import (
+        NYM, TARGET_NYM, TXN_TYPE, VERKEY)
+    from indy_plenum_tpu.common.request import Request
+    from indy_plenum_tpu.crypto.signers import DidSigner
+    from indy_plenum_tpu.tools import generate_pool_config
+    from indy_plenum_tpu.tools.local_pool import run_pool
+
+    directory = str(tmp_path / "pool")
+    info = generate_pool_config(directory, n_nodes=4, base_port=17700)
+    looper, nodes, stacks = run_pool(directory)
+    try:
+        from indy_plenum_tpu.tools.local_pool import load_secret_seed
+
+        trustee = DidSigner(load_secret_seed(directory, "trustee"))
+        import hashlib
+
+        target = DidSigner(hashlib.sha256(b"cli-target").digest())
+        req = Request(identifier=trustee.identifier, reqId=1,
+                      operation={TXN_TYPE: NYM,
+                                 TARGET_NYM: target.identifier,
+                                 VERKEY: target.verkey})
+        trustee.sign_request(req)
+        nodes[0].authnr.authenticate_batch([req])  # warm kernel compile
+        nodes[1].submit_client_request(req, client_id="cli")
+        ok = looper.run_until(
+            lambda: all(len(n.ordered_digests) == 1 for n in nodes),
+            timeout=30)
+        assert ok, [len(n.ordered_digests) for n in nodes]
+        assert all(n.get_nym_data(target.identifier) is not None
+                   for n in nodes)
+    finally:
+        for n in nodes:
+            n.stop()
+        looper.shutdown()
+        for s in stacks:
+            s.close()

@@ -99,10 +99,12 @@ def bench_ordered_txns_n64() -> dict:
 
     n_nodes = 64
     batch_size = 320
+    # the tick is SIM time (free): longer ticks mean fewer device
+    # round-trips per ordered batch with zero wall-clock latency cost
     config = getConfig({
         "Max3PCBatchSize": batch_size,
         "Max3PCBatchWait": 0.05,
-        "QuorumTickInterval": 0.05,
+        "QuorumTickInterval": 0.1,
     })
     pool = SimPool(n_nodes=n_nodes, seed=11, config=config,
                    device_quorum=True, shadow_check=False)

@@ -39,6 +39,11 @@ class Request:
         self.signature = signature
         self.signatures = signatures
         self.protocolVersion = protocolVersion
+        # digests are content hashes computed ONCE on first access (they
+        # key every propagation/3PC map, and the consensus hot path reads
+        # them constantly): mutate the payload only before the first read
+        self._digest: Optional[str] = None
+        self._payload_digest: Optional[str] = None
 
     @property
     def key(self) -> str:
@@ -46,16 +51,21 @@ class Request:
 
     @property
     def digest(self) -> str:
-        return hashlib.sha256(
-            serialize_for_signing(self.signing_payload())).hexdigest()
+        if self._digest is None:
+            self._digest = hashlib.sha256(
+                serialize_for_signing(self.signing_payload())).hexdigest()
+        return self._digest
 
     @property
     def payload_digest(self) -> str:
         """Digest without identifier -- used for replay detection across
         differently-signed duplicates (reference: Request.payload_digest)."""
-        payload = self.signing_payload()
-        payload.pop(f.IDENTIFIER, None)
-        return hashlib.sha256(serialize_for_signing(payload)).hexdigest()
+        if self._payload_digest is None:
+            payload = self.signing_payload()
+            payload.pop(f.IDENTIFIER, None)
+            self._payload_digest = hashlib.sha256(
+                serialize_for_signing(payload)).hexdigest()
+        return self._payload_digest
 
     def signing_payload(self) -> Dict[str, Any]:
         return {

@@ -77,10 +77,10 @@ def generate_pool_config(directory: str, n_nodes: int = 4,
             "node_ip": "127.0.0.1",
             "node_port": base_port + 2 * i,
         }
-        with open(os.path.join(keys_dir, f"{name}.json"), "w") as fh:
-            json.dump({"seed": node_seed.hex()}, fh)
-    with open(os.path.join(keys_dir, "trustee.json"), "w") as fh:
-        json.dump({"seed": derive("trustee").hex()}, fh)
+        _write_secret(os.path.join(keys_dir, f"{name}.json"),
+                      {"seed": node_seed.hex()})
+    _write_secret(os.path.join(keys_dir, "trustee.json"),
+                  {"seed": derive("trustee").hex()})
     info = {
         "trustee_did": trustee.identifier,
         "trustee_verkey": trustee.verkey,
@@ -92,6 +92,13 @@ def generate_pool_config(directory: str, n_nodes: int = 4,
     with open(os.path.join(directory, POOL_INFO), "w") as fh:
         json.dump(info, fh, indent=2, sort_keys=True)
     return info
+
+
+def _write_secret(path: str, payload: Dict) -> None:
+    """Owner-only (0600) secret files, like ssh/indy keygen tooling."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as fh:
+        json.dump(payload, fh)
 
 
 def load_secret_seed(directory: str, name: str) -> bytes:

@@ -223,10 +223,10 @@ class DeviceVotePlane:
         if self._events is None:  # nothing ever recorded
             self._state, self._events = _step(
                 self._state, q.pack_messages([], FLUSH_BATCH), self._n)
-        self._host_prepared = np.asarray(self._events.prepared)
-        self._host_prepare_counts = np.asarray(self._events.prepare_counts)
-        self._host_commit_counts = np.asarray(self._events.commit_counts)
-        self._host_stable = np.asarray(self._events.stable_checkpoints)
+        (self._host_prepared, self._host_prepare_counts,
+         self._host_commit_counts, self._host_stable) = jax.device_get(
+            (self._events.prepared, self._events.prepare_counts,
+             self._events.commit_counts, self._events.stable_checkpoints))
 
     def sync(self) -> None:
         """Flush all buffered votes and refresh the host snapshot (the
@@ -345,10 +345,12 @@ class VotePlaneGroup:
                     self._states, msgs, self._n)
                 self.flushes += 1
                 self.metrics.add_event(MetricsName.DEVICE_FLUSH)
-            self._host_prepared = np.asarray(events.prepared)
-            self._host_prepare_counts = np.asarray(events.prepare_counts)
-            self._host_commit_counts = np.asarray(events.commit_counts)
-            self._host_stable = np.asarray(events.stable_checkpoints)
+            # ONE bundled device->host transfer (separate np.asarray calls
+            # cost one link round-trip each — painful on a remote device)
+            (self._host_prepared, self._host_prepare_counts,
+             self._host_commit_counts, self._host_stable) = jax.device_get(
+                (events.prepared, events.prepare_counts,
+                 events.commit_counts, events.stable_checkpoints))
             self.version += 1
 
     def slide_member(self, member_idx: int, delta: int) -> None:

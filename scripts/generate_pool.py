@@ -8,9 +8,11 @@ Secrets land under DIR/keys/ — copy pool_info.json + genesis to every
 host, but each keys/<node>.json ONLY to that node's host. SEED_HEX (64
 hex chars) makes provisioning reproducible; omit it for fresh randomness.
 """
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 from indy_plenum_tpu.tools import generate_pool_config  # noqa: E402
 

@@ -6,9 +6,11 @@ Usage: python scripts/start_node.py DIR NODE_NAME
 ^C to stop. One process per validator; peers may live on other hosts as
 long as pool_info.json carries their reachable addresses.
 """
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 from indy_plenum_tpu.common.looper import Looper  # noqa: E402
 from indy_plenum_tpu.tools import build_node  # noqa: E402

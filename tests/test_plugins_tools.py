@@ -65,7 +65,14 @@ def test_plugin_registers_custom_txn_type_end_to_end():
         assert state.get(b"color", is_committed=True) == b"amaranth"
 
 
-def test_faulty_plugin_is_isolated():
+def test_faulty_plugin_fails_fast():
+    """A validator must NOT start with a configured plugin missing: running
+    without a handler its peers have means divergent roots and permanent
+    consensus dissent — fail-fast beats silently-degraded."""
+    import pytest
+
+    from indy_plenum_tpu.plugins.loader import PluginLoadError
+
     mod = types.ModuleType("exploding_plugin")
 
     def boom(node):
@@ -76,11 +83,11 @@ def test_faulty_plugin_is_isolated():
     config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
                         "PropagateBatchWait": 0.05,
                         "PluginModules": ("exploding_plugin",)})
-    pool = NodePool(4, seed=102, config=config)  # must not raise
-    req = pool.make_nym_request()
-    pool.submit_to("node0", req)
-    pool.run_for(15)
-    assert all(len(n.ordered_digests) == 1 for n in pool.nodes)
+    with pytest.raises(PluginLoadError):
+        NodePool(4, seed=102, config=config)
+    with pytest.raises(PluginLoadError):
+        NodePool(4, seed=103, config=getConfig(
+            {"PluginModules": ("no_such_module_xyz",)}))
 
 
 def test_pool_provisioning_roundtrip(tmp_path):
@@ -104,8 +111,10 @@ def test_pool_provisioning_roundtrip(tmp_path):
     # secrets live OUTSIDE the public pool info (per-host key isolation)
     assert "seed" not in info["nodes"]["node0"]
     assert "trustee_seed" not in info
-    from indy_plenum_tpu.tools.local_pool import load_secret_seed
+    from indy_plenum_tpu.tools.local_pool import KEYS_DIR, load_secret_seed
     assert len(load_secret_seed(directory, "node0")) == 32
+    mode = os.stat(os.path.join(directory, KEYS_DIR, "node0.json")).st_mode
+    assert mode & 0o077 == 0  # owner-only
     pool_txns = load_genesis_file(os.path.join(directory, POOL_GENESIS))
     domain_txns = load_genesis_file(os.path.join(directory, DOMAIN_GENESIS))
     assert len(pool_txns) == 4

@@ -16,6 +16,7 @@ from ...common.event_bus import ExternalBus, InternalBus
 from ...common.messages.internal_messages import (
     NodeNeedViewChange,
     PrimaryDisconnected,
+    RaisedSuspicion,
     VoteForViewChange,
 )
 from ...common.messages.node_messages import InstanceChange
@@ -50,6 +51,28 @@ class ViewChangeTriggerService:
         stasher.subscribe(InstanceChange, self.process_instance_change)
         bus.subscribe(VoteForViewChange, self.process_vote_for_view_change)
         bus.subscribe(PrimaryDisconnected, self.process_primary_disconnected)
+        bus.subscribe(RaisedSuspicion, self.process_raised_suspicion)
+
+    # suspicion codes that convict the PRIMARY of protocol fraud for the
+    # current view (reference: the instance-change-provoking suspicion set
+    # consumed by Node.reportSuspiciousNodeEx): equivocation, forged
+    # digests/roots/times, wrong discarded counts, bad multi-sigs in
+    # PRE-PREPAREs
+    PRIMARY_FAULT_CODES = frozenset({3, 6, 9, 10, 13, 15, 16, 17})
+
+    def process_raised_suspicion(self, msg: RaisedSuspicion, *args) -> None:
+        """Byzantine evidence that convicts the master primary becomes a
+        view-change vote — without this, an equivocating primary stalls
+        the pool in silence."""
+        if msg.inst_id != self._data.inst_id or not self._data.is_master:
+            return
+        ex = msg.ex
+        code = getattr(getattr(ex, "suspicion", None), "code", None)
+        if code in self.PRIMARY_FAULT_CODES \
+                and getattr(ex, "node", None) == self._data.primary_name:
+            logger.info("%s: primary %s convicted (%s) -> view change",
+                        self._data.name, ex.node, ex.suspicion)
+            self._send_instance_change(self._data.view_no + 1, ex.suspicion)
 
     # ------------------------------------------------------------------
 

@@ -307,6 +307,13 @@ class Node:
                                     self._on_catchup_finished)
         self.internal_bus.subscribe(RequestPropagates,
                                     self._on_request_propagates)
+        # bounded byzantine-evidence log (observability; the view-change
+        # vote on primary-convicting codes lives in the trigger service)
+        from collections import deque
+
+        self.suspicions = deque(maxlen=1000)
+        self.internal_bus.subscribe(RaisedSuspicion,
+                                    self._on_raised_suspicion)
 
         self._ingress_timer = RepeatingTimer(
             timer, self.config.PropagateBatchWait, self._flush_auth_queue,
@@ -471,6 +478,11 @@ class Node:
             self.monitor.reset(self.num_instances)
         if self.on_membership_changed_hook is not None:
             self.on_membership_changed_hook(validators, registry)
+
+    def _on_raised_suspicion(self, msg, *args) -> None:
+        ex = msg.ex
+        self.suspicions.append((getattr(ex, "node", None),
+                                getattr(ex, "suspicion", None)))
 
     def _on_view_change_started(self, msg, *args) -> None:
         # backups' votes are void in the new view; they rebuild at finish

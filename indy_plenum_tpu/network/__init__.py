@@ -28,9 +28,23 @@ class ZStackNetwork:
 
         self.bus = ExternalBus(send_handler)
         self.stack.on_message = self.bus.process_incoming
+        # socket-monitor liveness -> bus Connected/Disconnected events (the
+        # primary-disconnect detector runs on these over real sockets)
+        self.stack.on_connection_change = self._on_connection_change
         return self.bus
 
+    def _on_connection_change(self, peer: str, up: bool) -> None:
+        connecteds = set(self.bus.connecteds)
+        if up:
+            connecteds.add(peer)
+        else:
+            connecteds.discard(peer)
+        self.bus.update_connecteds(connecteds)
+
     def mark_connected(self, peers) -> None:
-        """Static-topology connection state (socket-level liveness events
-        arrive with the keep-alive/monitor layer)."""
-        self.bus.update_connecteds(set(peers))
+        """Optimistic initial topology, reconciled against any liveness
+        edges the stack observed before this composition attached (a peer
+        already seen to drop must not be resurrected optimistically)."""
+        known = self.stack.peer_states
+        self.bus.update_connecteds(
+            {p for p in peers if known.get(p, True)})

@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import zmq
 import zmq.utils.z85 as z85
+from zmq.utils.monitor import recv_monitor_message
 
 from ..common.messages.message_base import node_message_registry
 from ..common.messages.node_messages import Batch
@@ -79,6 +80,12 @@ class ZStack:
         self._poller.register(self._zap, zmq.POLLIN)
         self.received = 0
         self.rejected_unknown_key = 0
+        # liveness: libzmq socket monitors per remote feed the composition
+        # (handshake-succeeded = peer up, disconnected = peer down) — this
+        # is what lets the primary-disconnect detector work over sockets
+        self._monitors: Dict[zmq.Socket, str] = {}
+        self._peer_up: Dict[str, bool] = {}
+        self.on_connection_change = None  # (peer_name, up: bool) -> None
 
     # --- registry -------------------------------------------------------
 
@@ -100,6 +107,14 @@ class ZStack:
         sock.setsockopt(zmq.CURVE_PUBLICKEY, self.public_key)
         sock.setsockopt(zmq.CURVE_SECRETKEY, self._secret_key)
         sock.setsockopt(zmq.LINGER, 0)
+        # EVENT_CLOSED is deliberately absent: libzmq's connecter also
+        # emits it for every FAILED connect attempt (peer not bound yet),
+        # which would report a never-connected peer as "down" at startup.
+        # DISCONNECTED only fires after an established session drops.
+        monitor = sock.get_monitor_socket(
+            zmq.EVENT_HANDSHAKE_SUCCEEDED | zmq.EVENT_DISCONNECTED)
+        self._monitors[monitor] = name
+        self._poller.register(monitor, zmq.POLLIN)
         sock.connect(f"tcp://{ha[0]}:{ha[1]}")
         self._remotes[name] = sock
 
@@ -220,12 +235,42 @@ class ZStack:
         if self.on_message is not None:
             self.on_message(msg, sender)
 
+    @property
+    def peer_states(self) -> Dict[str, bool]:
+        """Last known liveness per peer (edges observed so far) — lets a
+        late-attaching composition reconcile instead of losing edges."""
+        return dict(self._peer_up)
+
+    def _service_monitors(self, events) -> None:
+        for mon, peer in list(self._monitors.items()):
+            if mon not in events:
+                continue
+            while True:
+                try:
+                    evt = recv_monitor_message(mon, flags=zmq.NOBLOCK)
+                except zmq.Again:
+                    break
+                kind = evt["event"]
+                if kind == zmq.EVENT_HANDSHAKE_SUCCEEDED:
+                    up = True
+                elif kind == zmq.EVENT_DISCONNECTED:
+                    up = False
+                else:
+                    continue
+                if self._peer_up.get(peer) != up:
+                    self._peer_up[peer] = up
+                    logger.info("%s: peer %s %s", self.name, peer,
+                                "up" if up else "down")
+                    if self.on_connection_change is not None:
+                        self.on_connection_change(peer, up)
+
     def service(self, timeout_ms: int = 0) -> int:
         """Pump ZAP + inbound + outbound once; returns messages handled."""
         handled = 0
         events = dict(self._poller.poll(timeout_ms))
         if self._zap in events:
             self._service_zap()
+        self._service_monitors(events)
         if self._listener in events:
             while True:
                 try:
@@ -244,7 +289,13 @@ class ZStack:
 
     def close(self) -> None:
         for sock in self._remotes.values():
+            try:
+                sock.disable_monitor()
+            except Exception:  # noqa: BLE001
+                pass
             sock.close(0)
+        for mon in self._monitors:
+            mon.close(0)
         self._listener.close(0)
         self._zap.close(0)
         self._ctx.term()

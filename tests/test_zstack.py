@@ -193,3 +193,54 @@ def test_malformed_batch_from_authenticated_peer_is_contained():
     assert [m.seqNoEnd for m in got] == [42]
     for s in stacks.values():
         s.close()
+
+
+def test_primary_crash_detected_and_view_changed_over_sockets():
+    """Socket liveness: the primary's process dies (stack closed); the
+    libzmq monitors report the drop, the primary-disconnect detector
+    votes, and the pool completes a view change over REAL sockets."""
+    from indy_plenum_tpu.common.constants import TRUSTEE
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.crypto.signers import DidSigner
+    from indy_plenum_tpu.ledger.genesis import genesis_nym_txn
+
+    names = [f"node{i}" for i in range(4)]
+    config = getConfig({"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.02,
+                        "ToleratePrimaryDisconnection": 1.0,
+                        "ViewChangeResendInterval": 1.0})
+    trustee = DidSigner(b"\x09" * 32)
+    genesis = [genesis_nym_txn(trustee.identifier, trustee.verkey,
+                               role=TRUSTEE)]
+    looper = Looper()
+    stacks = wire(names)
+    nodes = []
+    for name in names:
+        net = ZStackNetwork(stacks[name])
+        node = Node(name, names, looper.timer, net, config=config,
+                    domain_genesis=[dict(t) for t in genesis],
+                    seed_keys={trustee.identifier: trustee.verkey})
+        net.mark_connected(set(names) - {name})
+        node.start()
+        looper.add(stacks[name])
+        nodes.append(node)
+    # let the curve handshakes complete
+    looper.run_for(1.0)
+
+    assert nodes[1].data.primaries[0] == "node0"
+    looper.remove(stacks["node0"])
+    nodes[0].stop()
+    stacks["node0"].close()  # the primary process dies
+
+    survivors = nodes[1:]
+    ok = looper.run_until(
+        lambda: all(n.data.view_no >= 1 and not n.data.waiting_for_new_view
+                    for n in survivors),
+        timeout=30)
+    assert ok, [(n.name, n.data.view_no) for n in survivors]
+    assert all(n.data.primaries[0] != "node0" for n in survivors)
+    for n in survivors:
+        n.stop()
+    looper.shutdown()
+    for name in names[1:]:
+        stacks[name].close()

@@ -25,6 +25,10 @@ NODE = "0"  # pool: validator membership
 GET_TXN = "3"
 AUDIT = "2"  # audit ledger txn (one per 3PC batch)
 GET_NYM = "105"
+# action types (executed immediately on the receiving node, never written
+# to a ledger; reference: plenum's ActionReqManager)
+POOL_RESTART = "118"
+VALIDATOR_INFO = "119"
 
 # --- roles ----------------------------------------------------------------
 TRUSTEE = "0"

@@ -90,6 +90,9 @@ class Config:
     # --- request handling -------------------------------------------------
     ReplyCacheSize: int = 10000
     ProcessedBatchMapsToKeep: int = 100
+    # privileged actions must carry a node-clock timestamp this fresh
+    # (replay window; seen digests are deduped inside it)
+    ActionFreshnessWindow: float = 300.0
 
     # --- metrics / observability -----------------------------------------
     METRICS_COLLECTOR_TYPE: Optional[str] = "kv"

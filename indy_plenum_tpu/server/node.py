@@ -169,6 +169,23 @@ class Node:
 
         self.read_manager = ReadRequestManager(
             self.boot.db, bls_multi_sig_getter=self._find_multi_sig)
+        from .request_managers.action_request_manager import (
+            ActionRequestManager,
+        )
+
+        self.restart_requested = False
+
+        def _restart_sink():
+            self.restart_requested = True  # composition reacts (test flag
+            # in-process; a deployment wires a process-exit/systemd hook)
+
+        self.action_manager = ActionRequestManager(
+            node_status_provider=self.node_status,
+            get_nym_data=self.boot.nym_handler.get_nym_data,
+            timer=timer, restart_sink=_restart_sink)
+        from collections import deque
+
+        self._seen_action_digests = deque(maxlen=1000)
 
         # --- ingress: state-backed authn + propagation ------------------
         self.authnr = CoreAuthNr(verkey_source=self.boot.nym_handler,
@@ -376,6 +393,8 @@ class Node:
         of writes is asynchronous (device-batched on the ingress tick).
         Reads are served immediately by THIS node — the reply carries the
         proof material that makes one answer trustworthy."""
+        if self.action_manager.is_action(req.txn_type):
+            return self._handle_action_request(req, client_id)
         if self.read_manager.is_read(req.txn_type):
             try:
                 result = self.read_manager.handle(req)
@@ -405,6 +424,71 @@ class Node:
             self._req_clients[req.digest] = client_id
         self._auth_queue.append(req)
         return True
+
+    def _handle_action_request(self, req: Request,
+                               client_id: Optional[str]) -> bool:
+        """Actions are privileged and rare: authenticate synchronously
+        (host path), authorize by role, execute immediately."""
+
+        def nack(reason: str) -> bool:
+            self._to_client(client_id, RequestNack(
+                identifier=req.identifier, reqId=req.reqId, reason=reason))
+            return False
+
+        try:
+            verified = self.authnr.authenticate(req)
+        except Exception:  # noqa: BLE001 — any auth failure is a NACK
+            return nack("signature verification failed")
+        if req.identifier not in verified:
+            # the AUTHOR must be among the verified signers: authorization
+            # reads request.identifier's role, and a multi-sig endorsement
+            # by someone else must not let an attacker borrow a privileged
+            # identifier (privilege escalation found in review)
+            return nack("author did not sign the request")
+        # replay protection: actions never hit the ledger dedup, so a
+        # captured signed POOL_RESTART would otherwise be replayable
+        # forever — require a fresh node-clock timestamp and reject
+        # digests seen inside the freshness window
+        ts = req.operation.get("timestamp")
+        now = self.timer.get_current_time()
+        window = self.config.ActionFreshnessWindow
+        if not isinstance(ts, (int, float)) or not (
+                now - window <= ts <= now + window):
+            return nack("action needs a fresh 'timestamp' (node clock, "
+                        f"within {window}s)")
+        if req.digest in self._seen_action_digests:
+            return nack("action replayed")
+        self._seen_action_digests.append(req.digest)
+        try:
+            result = self.action_manager.handle(req)
+        except InvalidClientRequest as ex:  # incl. Unauthorized subclass
+            return nack(str(ex))
+        except Exception:  # noqa: BLE001
+            logger.exception("%s: action request failed", self.name)
+            return nack("malformed action request")
+        result.update(identifier=req.identifier, reqId=req.reqId)
+        self._to_client(client_id, Reply(result=result))
+        return True
+
+    def node_status(self) -> Dict[str, Any]:
+        """VALIDATOR_INFO payload: the operational snapshot."""
+        ledgers = {}
+        for lid in self.boot.db.ledger_ids:
+            ledger = self.boot.db.get_ledger(lid)
+            if ledger is not None:
+                ledgers[str(lid)] = ledger.size
+        return {
+            "name": self.name,
+            "view_no": self.data.view_no,
+            "last_ordered_3pc": list(self.data.last_ordered_3pc),
+            "stable_checkpoint": self.data.stable_checkpoint,
+            "validators": list(self.data.validators),
+            "primaries": list(self.data.primaries),
+            "is_participating": self.data.is_participating,
+            "ledger_sizes": ledgers,
+            "num_instances": self.num_instances,
+            "metrics": self.metrics.summary(),
+        }
 
     def _enqueue_for_auth(self, req: Request) -> None:
         """Relayed PROPAGATE whose request we haven't authenticated."""

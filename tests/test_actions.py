@@ -1,0 +1,143 @@
+"""Action request manager: VALIDATOR_INFO + POOL_RESTART.
+
+Reference: plenum/server/request_managers/action_request_manager.py.
+Actions execute immediately on the receiving node (no consensus round),
+but are PRIVILEGED: authenticated signature + authorized role required.
+"""
+from indy_plenum_tpu.common.constants import (
+    POOL_RESTART,
+    TXN_TYPE,
+    VALIDATOR_INFO,
+)
+from indy_plenum_tpu.common.messages.node_messages import Reply, RequestNack
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+
+def _submit_action(pool, node_name, signer, op, req_id=1, stamp=True):
+    op = dict(op)
+    if stamp and "timestamp" not in op:
+        op["timestamp"] = pool.timer.get_current_time()
+    req = Request(identifier=signer.identifier, reqId=req_id, operation=op)
+    signer.sign_request(req)
+    ok = pool.node(node_name).submit_client_request(req, client_id="ops")
+    msgs = [m for c, m in pool.node(node_name).client_outbox if c == "ops"]
+    pool.node(node_name).client_outbox.clear()
+    return ok, msgs
+
+
+def test_validator_info_returns_status_snapshot():
+    pool = NodePool(4, seed=111)
+    pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(15)
+
+    ok, msgs = _submit_action(pool, "node2", pool.trustee,
+                              {TXN_TYPE: VALIDATOR_INFO})
+    assert ok
+    (reply,) = [m for m in msgs if isinstance(m, Reply)]
+    data = reply.result["data"]
+    assert data["name"] == "node2"
+    assert data["last_ordered_3pc"][1] >= 1
+    assert data["validators"] == pool.validators
+    assert data["ledger_sizes"]["1"] >= 2  # genesis + the NYM
+
+
+def test_pool_restart_schedules_and_fires():
+    pool = NodePool(4, seed=112)
+    node = pool.node("node1")
+    now = pool.timer.get_current_time()
+    ok, msgs = _submit_action(pool, "node1", pool.trustee,
+                              {TXN_TYPE: POOL_RESTART, "datetime": now + 5})
+    assert ok
+    (reply,) = [m for m in msgs if isinstance(m, Reply)]
+    assert 4.0 <= reply.result["scheduled_in"] <= 5.0
+    assert not node.restart_requested
+    pool.run_for(6)
+    assert node.restart_requested
+    # a past timestamp is rejected
+    ok, msgs = _submit_action(pool, "node1", pool.trustee,
+                              {TXN_TYPE: POOL_RESTART, "datetime": 12345},
+                              req_id=2)
+    assert not ok
+    assert any(isinstance(m, RequestNack) for m in msgs)
+
+
+def test_actions_are_privileged():
+    import hashlib
+
+    from indy_plenum_tpu.crypto.signers import DidSigner
+
+    pool = NodePool(4, seed=113)
+    # a known identity WITHOUT a privileged role: write its NYM first
+    nym = pool.make_nym_request()
+    pool.submit_to("node0", nym)
+    pool.run_for(15)
+    nobody = nym.target_signer
+
+    ok, msgs = _submit_action(pool, "node0", nobody,
+                              {TXN_TYPE: VALIDATOR_INFO})
+    assert not ok
+    assert any(isinstance(m, RequestNack) and "may not run" in m.reason
+               for m in msgs)
+    # restart needs TRUSTEE even though info allows STEWARD
+    steward = DidSigner(hashlib.sha256(b"no-such-steward").digest())
+    ok, msgs = _submit_action(pool, "node0", steward,
+                              {TXN_TYPE: POOL_RESTART}, req_id=3)
+    assert not ok
+
+    # forged signature never reaches authorization
+    req = Request(identifier=pool.trustee.identifier, reqId=4,
+                  operation={TXN_TYPE: VALIDATOR_INFO,
+                             "timestamp": pool.timer.get_current_time()})
+    pool.trustee.sign_request(req)
+    req.operation["evil"] = True
+    assert not pool.node("node0").submit_client_request(req, client_id="x")
+
+
+def test_action_endorsement_cannot_borrow_privileged_identifier():
+    """Privilege-escalation regression: a request CLAIMING the trustee as
+    identifier but signed only by an unprivileged endorser must be NACKed
+    — authorization reads the author's role, so the author must sign."""
+    pool = NodePool(4, seed=114)
+    nym = pool.make_nym_request()
+    pool.submit_to("node0", nym)
+    pool.run_for(15)
+    attacker = nym.target_signer
+
+    evil = Request(identifier=pool.trustee.identifier, reqId=50,
+                   operation={TXN_TYPE: POOL_RESTART,
+                              "timestamp": pool.timer.get_current_time()})
+    # NO author signature; only the attacker's (valid) endorsement over
+    # the evil request's exact signing bytes
+    from indy_plenum_tpu.utils.base58 import b58encode
+
+    evil.signatures = {attacker.identifier: b58encode(
+        attacker.sign_bytes(evil.signing_bytes()))}
+    node = pool.node("node0")
+    assert not node.submit_client_request(evil, client_id="x")
+    assert not node.restart_requested
+
+
+def test_action_replay_and_staleness_rejected():
+    pool = NodePool(4, seed=115)
+    node = pool.node("node2")
+    op = {TXN_TYPE: VALIDATOR_INFO,
+          "timestamp": pool.timer.get_current_time()}
+    req = Request(identifier=pool.trustee.identifier, reqId=60,
+                  operation=op)
+    pool.trustee.sign_request(req)
+    assert node.submit_client_request(req, client_id="ops")
+    # the identical signed bytes again: replay -> NACK
+    assert not node.submit_client_request(req, client_id="ops")
+    # a stale timestamp (outside the freshness window) -> NACK
+    stale = Request(identifier=pool.trustee.identifier, reqId=61,
+                    operation={TXN_TYPE: VALIDATOR_INFO,
+                               "timestamp":
+                               pool.timer.get_current_time() - 10_000})
+    pool.trustee.sign_request(stale)
+    assert not node.submit_client_request(stale, client_id="ops")
+    # missing timestamp -> NACK
+    missing = Request(identifier=pool.trustee.identifier, reqId=62,
+                      operation={TXN_TYPE: VALIDATOR_INFO})
+    pool.trustee.sign_request(missing)
+    assert not node.submit_client_request(missing, client_id="ops")

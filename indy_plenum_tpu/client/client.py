@@ -71,6 +71,9 @@ class Client:
         self._now = now_provider
         self._proof_max_age = proof_max_age
         self.pending: Dict[str, PendingRequest] = {}  # digest -> state
+        # (identifier, reqId) -> state: inbound ACK/NACK/REPLY matching is
+        # O(1), not O(pending) — the load-generator shape
+        self._by_idr: Dict[tuple, PendingRequest] = {}
         self.proved_reads: Dict[str, dict] = {}  # digest -> verified result
 
     @property
@@ -94,8 +97,9 @@ class Client:
         """Send a write to ``to`` (default: all validators — the client
         needs f+1 REPLYs, and up to f nodes may ignore it)."""
         targets = to if to is not None else list(self._validators)
-        self.pending[request.digest] = PendingRequest(
+        state = self.pending[request.digest] = PendingRequest(
             request, needed=self._f + 1)
+        self._by_idr[(request.identifier, request.reqId)] = state
         for node in targets:
             self._send(request, node, self.name)
         return request.digest
@@ -108,11 +112,14 @@ class Client:
         unproved answer is never trusted."""
         if request.txn_type == GET_NYM:
             node = to or self._validators[0]
-            self.pending[request.digest] = PendingRequest(request, needed=1)
+            state = self.pending[request.digest] = PendingRequest(
+                request, needed=1)
+            self._by_idr[(request.identifier, request.reqId)] = state
             self._send(request, node, self.name)
         else:
-            self.pending[request.digest] = PendingRequest(
+            state = self.pending[request.digest] = PendingRequest(
                 request, needed=self._f + 1)
+            self._by_idr[(request.identifier, request.reqId)] = state
             for node in self._validators:
                 self._send(request, node, self.name)
         return request.digest
@@ -128,11 +135,7 @@ class Client:
             self._process_ack(node_name, msg)
 
     def _match_pending(self, identifier, req_id) -> Optional[PendingRequest]:
-        for state in self.pending.values():
-            if (state.request.identifier == identifier
-                    and state.request.reqId == req_id):
-                return state
-        return None
+        return self._by_idr.get((identifier, req_id))
 
     def _process_ack(self, node_name: str, msg: RequestAck) -> None:
         state = self._match_pending(msg.identifier, msg.reqId)

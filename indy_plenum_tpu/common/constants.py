@@ -23,6 +23,8 @@ CATCHUP_ORDER = (AUDIT_LEDGER_ID, POOL_LEDGER_ID, CONFIG_LEDGER_ID,
 NYM = "1"  # domain: identity CRUD
 NODE = "0"  # pool: validator membership
 GET_TXN = "3"
+POOL_CONFIG = "111"  # config: pool-wide protocol parameters
+WRITES = "writes"  # POOL_CONFIG field: pool accepts write requests
 AUDIT = "2"  # audit ledger txn (one per 3PC batch)
 GET_NYM = "105"
 # action types (executed immediately on the receiving node, never written
@@ -46,6 +48,11 @@ NODE_IP = "node_ip"
 NODE_PORT = "node_port"
 CLIENT_IP = "client_ip"
 CLIENT_PORT = "client_port"
+# the node's CurveZMQ transport public key, carried in NODE txn data so
+# membership changes can rewire transports (the reference derives curve
+# keys from the node verkey; an explicit field is the honest equivalent
+# for our from-seed curve keys)
+TRANSPORT_VERKEY = "transport_verkey"
 SERVICES = "services"
 BLS_KEY = "blskey"
 BLS_KEY_PROOF = "blskey_pop"

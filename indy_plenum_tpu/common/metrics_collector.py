@@ -31,6 +31,10 @@ class MetricsName:
     DEVICE_FLUSH_VOTES = "device.flush_votes"
     # execution
     COMMIT_TIME = "exec.commit_time"
+    # catchup
+    CATCHUP_FAILED = "catchup.failed"
+    # transport
+    ZSTACK_DROPPED = "zstack.dropped"
 
 
 class Stat:

@@ -55,6 +55,11 @@ class Config:
     CatchupTransactionsTimeout: float = 6.0
     ConsistencyProofsTimeout: float = 5.0
     CatchupBatchSize: int = 5000  # txns per CATCHUP_REQ slice
+    # fail-closed retry: a node whose catchup FAILED (history convicted as
+    # diverged but no honest quorum reachable) stays non-participating and
+    # retries with exponential backoff between these bounds
+    CatchupFailedRetryBackoff: float = 10.0
+    CatchupFailedRetryBackoffMax: float = 300.0
 
     # --- propagation ------------------------------------------------------
     PROPAGATE_PHASE_DONE_TIMEOUT: float = 30.0

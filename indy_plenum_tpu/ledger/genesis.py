@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 from ..common.constants import (
     ALIAS,
     BLS_KEY,
+    BLS_KEY_PROOF,
     CLIENT_IP,
     CLIENT_PORT,
     CURRENT_TXN_VERSION,
@@ -66,7 +67,12 @@ def genesis_nym_txn(did: str, verkey: Optional[str] = None,
 def genesis_node_txn(node_nym: str, alias: str, steward_did: str,
                      node_ip: str = "127.0.0.1", node_port: int = 9701,
                      client_ip: str = "127.0.0.1", client_port: int = 9702,
-                     blskey: Optional[str] = None) -> Dict[str, Any]:
+                     blskey: Optional[str] = None,
+                     blskey_pop: Optional[str] = None,
+                     transport_verkey: Optional[str] = None
+                     ) -> Dict[str, Any]:
+    from ..common.constants import TRANSPORT_VERKEY
+
     data = {
         TARGET_NYM: node_nym,
         "data": {
@@ -77,6 +83,9 @@ def genesis_node_txn(node_nym: str, alias: str, steward_did: str,
             CLIENT_PORT: client_port,
             SERVICES: [VALIDATOR],
             **({BLS_KEY: blskey} if blskey else {}),
+            **({BLS_KEY_PROOF: blskey_pop} if blskey_pop else {}),
+            **({TRANSPORT_VERKEY: transport_verkey}
+               if transport_verkey else {}),
         },
     }
     return _txn(NODE, data, frm=steward_did)

@@ -48,3 +48,31 @@ class ZStackNetwork:
         known = self.stack.peer_states
         self.bus.update_connecteds(
             {p for p in peers if known.get(p, True)})
+
+    def membership_hook(self, validators, registry) -> None:
+        """Consumer for ``Node.on_membership_changed_hook`` (reference:
+        KITZStack reacting to pool-ledger changes): members that left are
+        disconnected; members whose NODE txn carries transport info are
+        connected — or RECONNECTED when their key/address rotated. Records
+        without transport info (static wiring) are left untouched."""
+        from ..common.constants import (
+            NODE_IP,
+            NODE_PORT,
+            TRANSPORT_VERKEY,
+        )
+
+        own = self.stack.name
+        members = set(validators)
+        for peer in list(self.stack.connected_peers):
+            if peer not in members:
+                self.stack.disconnect_peer(peer)
+                self._on_connection_change(peer, False)
+        for alias in validators:
+            if alias == own:
+                continue
+            rec = registry.get(alias) or {}
+            key = rec.get(TRANSPORT_VERKEY)
+            host, port = rec.get(NODE_IP), rec.get(NODE_PORT)
+            if not key or not host or not port:
+                continue
+            self.stack.upsert_peer(alias, (host, int(port)), key.encode())

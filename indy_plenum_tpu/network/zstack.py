@@ -19,6 +19,7 @@ Wire format: msgpack of the registry dict form (``op`` field dispatch).
 from __future__ import annotations
 
 import logging
+import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -28,6 +29,7 @@ from zmq.utils.monitor import recv_monitor_message
 
 from ..common.messages.message_base import node_message_registry
 from ..common.messages.node_messages import Batch
+from ..common.metrics_collector import MetricsName
 from ..common.serializers.serialization import (
     deserialize_msgpack,
     serialize_msg,
@@ -49,14 +51,22 @@ class ZStack:
                  bind_host: str = "127.0.0.1",
                  bind_port: int = 0,
                  max_batch: int = 100,
-                 msg_len_limit: int = 128 * 1024):
+                 msg_len_limit: int = 128 * 1024,
+                 metrics=None,
+                 reconnect_interval: float = 2.0):
         self.name = name
         self.public_key, self._secret_key = curve_keypair_from_seed(seed)
         self.on_message = on_message  # (msg_obj, sender_name) -> None
         self._max_batch = max_batch
         self._msg_len_limit = msg_len_limit
+        self._metrics = metrics  # optional MetricsCollector
 
         self._ctx = zmq.Context()
+        # never block interpreter shutdown: ctx.term() waits for open
+        # sockets forever by default, so a composition that forgot close()
+        # would hang Python at GC (observed in the test suite)
+        self._ctx.set(zmq.BLOCKY, False)
+        self._closed = False
         # ZAP handler must exist before any curve-server socket binds.
         # ROUTER, not REP: concurrent handshakes (the whole pool connecting
         # at startup) put several ZAP requests in flight at once, and REP's
@@ -74,18 +84,32 @@ class ZStack:
         self.ha: Tuple[str, int] = (bind_host, int(endpoint.rsplit(":", 1)[1]))
 
         self._remotes: Dict[str, zmq.Socket] = {}
+        self._remote_ha: Dict[str, Tuple[str, int]] = {}
         self._outbox: Dict[str, List[bytes]] = defaultdict(list)
         self._poller = zmq.Poller()
         self._poller.register(self._listener, zmq.POLLIN)
         self._poller.register(self._zap, zmq.POLLIN)
         self.received = 0
         self.rejected_unknown_key = 0
+        # messages lost to a full peer HWM ("UDP-like" sends): without this
+        # counter a saturated pool is slow in a way metrics can't explain
+        self.dropped = 0
         # liveness: libzmq socket monitors per remote feed the composition
         # (handshake-succeeded = peer up, disconnected = peer down) — this
         # is what lets the primary-disconnect detector work over sockets
         self._monitors: Dict[zmq.Socket, str] = {}
         self._peer_up: Dict[str, bool] = {}
         self.on_connection_change = None  # (peer_name, up: bool) -> None
+        # keep-in-touch (reference: stp_zmq/kit_zstack.py): periodically
+        # RECREATE the DEALER of any peer whose curve handshake hasn't
+        # succeeded. Necessary, not cosmetic: a ZAP-rejected handshake is
+        # TERMINAL for that socket in libzmq (observed: no further
+        # reconnect attempts), so a peer admitted to the registry after a
+        # first failed attempt — the add-a-node flow — would never become
+        # reachable without this.
+        self._reconnect_interval = reconnect_interval
+        self._last_reconnect_check = time.monotonic()
+        self.reconnects = 0
 
     # --- registry -------------------------------------------------------
 
@@ -117,10 +141,81 @@ class ZStack:
         self._poller.register(monitor, zmq.POLLIN)
         sock.connect(f"tcp://{ha[0]}:{ha[1]}")
         self._remotes[name] = sock
+        self._remote_ha[name] = (ha[0], int(ha[1]))
 
     @property
     def connected_peers(self) -> List[str]:
         return list(self._remotes)
+
+    # --- keep-in-touch registry sync (reference: stp_zmq/kit_zstack.py) -
+
+    def _close_remote(self, name: str) -> None:
+        """Close ``name``'s DEALER + monitor; registry entries survive."""
+        sock = self._remotes.pop(name, None)
+        if sock is None:
+            return
+        for mon, peer in list(self._monitors.items()):
+            if peer == name:
+                try:
+                    self._poller.unregister(mon)
+                except KeyError:
+                    pass
+                mon.close(0)
+                del self._monitors[mon]
+        try:
+            sock.disable_monitor()
+        except Exception:  # noqa: BLE001
+            pass
+        sock.close(0)
+
+    def disconnect_peer(self, name: str) -> None:
+        """Close the DEALER to ``name`` and forget its curve key (member
+        removed, or about to be reconnected under a new key)."""
+        self._close_remote(name)
+        self._outbox.pop(name, None)
+        self._remote_ha.pop(name, None)
+        self.disallow_peer(name)
+        self._peer_up.pop(name, None)
+
+    def _retry_dead_connections(self) -> None:
+        """KIT reconnect pass: any peer without a completed handshake gets
+        a FRESH DEALER (old one may be in the terminal post-ZAP-reject
+        state); queued outbox survives and flushes once the new session
+        comes up."""
+        now = time.monotonic()
+        if now - self._last_reconnect_check < self._reconnect_interval:
+            return
+        self._last_reconnect_check = now
+        for name in list(self._remotes):
+            if self._peer_up.get(name) is True:
+                continue
+            ha = self._remote_ha.get(name)
+            key = next((k for k, p in self._allowed.items() if p == name),
+                       None)
+            if ha is None or key is None:
+                continue
+            self._close_remote(name)
+            self.connect(name, ha, key)
+            self.reconnects += 1
+
+    def upsert_peer(self, name: str, ha: Tuple[str, int],
+                    public_z85: bytes) -> bool:
+        """Connect a new peer, or RESTART the connection when its curve
+        key or address changed (the rotation path); returns True if the
+        connection was (re)established."""
+        key = bytes(public_z85)
+        ha = (ha[0], int(ha[1]))
+        if name in self._remotes:
+            current_key = next((k for k, p in self._allowed.items()
+                                if p == name), None)
+            if current_key == key and self._remote_ha.get(name) == ha:
+                return False  # unchanged
+            logger.info("%s: peer %s rotated its transport key or "
+                        "address; restarting connection", self.name, name)
+            self.disconnect_peer(name)
+        self.allow_peer(name, key)
+        self.connect(name, ha, key)
+        return True
 
     # --- sending --------------------------------------------------------
 
@@ -151,8 +246,13 @@ class ZStack:
                 try:
                     sock.send(payload, flags=zmq.NOBLOCK)
                 except zmq.Again:  # peer HWM reached; drop (UDP-like)
-                    logger.warning("%s: send queue full for %s", self.name,
-                                   peer)
+                    self.dropped += len(chunk)
+                    if self._metrics is not None:
+                        self._metrics.add_event(MetricsName.ZSTACK_DROPPED,
+                                                len(chunk))
+                    logger.warning("%s: send queue full for %s; %d "
+                                   "message(s) dropped", self.name, peer,
+                                   len(chunk))
                     break
 
     # --- receiving ------------------------------------------------------
@@ -271,6 +371,7 @@ class ZStack:
         if self._zap in events:
             self._service_zap()
         self._service_monitors(events)
+        self._retry_dead_connections()
         if self._listener in events:
             while True:
                 try:
@@ -288,6 +389,9 @@ class ZStack:
         return handled
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         for sock in self._remotes.values():
             try:
                 sock.disable_monitor()

@@ -41,8 +41,11 @@ from ...common.messages.internal_messages import (
     CatchupFinished,
     NeedMasterCatchup,
 )
+from ...common.exceptions import SuspiciousNode
+from ...common.metrics_collector import MetricsName
 from ...common.timer import TimerService
 from ...common.txn_util import get_payload_data
+from ..suspicion_codes import Suspicions
 from ...utils.base58 import b58decode, b58encode
 from .catchup_rep_service import CatchupRepService
 from .cons_proof_service import ConsProofService
@@ -61,9 +64,11 @@ class NodeLeecherService:
                  timer: TimerService,
                  bootstrap,
                  config=None,
-                 suspicion_sink=None):
+                 suspicion_sink=None,
+                 metrics=None):
         """``bootstrap`` is the node's LedgersBootstrap (ledgers, states,
         write manager, state-rebuild)."""
+        from ...common.metrics_collector import NullMetricsCollector
         from ...config import getConfig
 
         self._data = data
@@ -73,11 +78,14 @@ class NodeLeecherService:
         self._boot = bootstrap
         self._config = config or getConfig()
         self._suspicion = suspicion_sink or (lambda ex: None)
+        self._metrics = metrics if metrics is not None \
+            else NullMetricsCollector()
 
         self._running = False
         self._audit_attempts = 0
         self._remaining: List[int] = []
         self.catchups_completed = 0  # observability / tests
+        self.catchups_failed = 0  # consecutive failures (backoff exponent)
 
         self._cons_proof = ConsProofService(
             AUDIT_LEDGER_ID, network, timer, self._boot.db,
@@ -95,6 +103,14 @@ class NodeLeecherService:
 
     def _on_need_catchup(self, msg: NeedMasterCatchup, *args) -> None:
         self.start()
+
+    def _retry_after_failure(self) -> None:
+        # only act if the node is still in the failed state: a catchup
+        # triggered by other means (checkpoint lag) may have succeeded
+        # since this timer was scheduled, and a healthy participating
+        # node must not be yanked back into catchup by a stale timer
+        if not self._running and self.catchups_failed > 0:
+            self.start()
 
     def start(self) -> None:
         """Idempotent: a second trigger while catching up is a no-op."""
@@ -215,8 +231,28 @@ class NodeLeecherService:
     def _finish(self, failed: bool = False) -> None:
         self._running = False
         if failed:
-            self._data.is_participating = True
+            # FAIL CLOSED (reference: a node stays in Mode.syncing, never
+            # participating, until caught up): our history was convicted as
+            # diverged (f+1 peers) but we could not resync to any honest
+            # quorum target. Resuming votes/orders/reads from state we KNOW
+            # is wrong would be a safety violation — stay out, alert the
+            # operator, retry on an exponential backoff.
+            self._data.is_participating = False
+            self.catchups_failed += 1
+            self._metrics.add_event(MetricsName.CATCHUP_FAILED)
+            self._suspicion(SuspiciousNode(
+                self._data.name, Suspicions.CATCHUP_FAILED))
+            delay = min(
+                self._config.CatchupFailedRetryBackoff
+                * (2 ** (self.catchups_failed - 1)),
+                self._config.CatchupFailedRetryBackoffMax)
+            logger.error("%s: catchup FAILED (%d consecutive); staying "
+                         "non-participating, retrying in %.1fs",
+                         self._data.name, self.catchups_failed, delay)
+            self._timer.schedule(delay, self._retry_after_failure)
             return
+        self.catchups_failed = 0
+        self._timer.cancel(self._retry_after_failure)
         # states are derived: replay fetched txns through the handlers
         # (coverage located via the audit spine)
         self._boot._rebuild_states_if_behind()

@@ -53,12 +53,22 @@ class ViewChangeTriggerService:
         bus.subscribe(PrimaryDisconnected, self.process_primary_disconnected)
         bus.subscribe(RaisedSuspicion, self.process_raised_suspicion)
 
-    # suspicion codes that convict the PRIMARY of protocol fraud for the
+    # suspicions that convict the PRIMARY of protocol fraud for the
     # current view (reference: the instance-change-provoking suspicion set
     # consumed by Node.reportSuspiciousNodeEx): equivocation, forged
     # digests/roots/times, wrong discarded counts, bad multi-sigs in
-    # PRE-PREPAREs
-    PRIMARY_FAULT_CODES = frozenset({3, 6, 9, 10, 13, 15, 16, 17})
+    # PRE-PREPAREs. Derived from the named catalogue so a renumbering in
+    # suspicion_codes.py cannot silently desync this set.
+    PRIMARY_FAULT_CODES = frozenset(s.code for s in (
+        Suspicions.DUPLICATE_PPR_SENT,
+        Suspicions.PPR_DIGEST_WRONG,
+        Suspicions.PPR_STATE_WRONG,
+        Suspicions.PPR_TXN_WRONG,
+        Suspicions.PPR_TIME_WRONG,
+        Suspicions.PPR_BLS_MULTISIG_WRONG,
+        Suspicions.PPR_AUDIT_TXN_ROOT_WRONG,
+        Suspicions.PPR_DISCARDED_WRONG,
+    ))
 
     def process_raised_suspicion(self, msg: RaisedSuspicion, *args) -> None:
         """Byzantine evidence that convicts the master primary becomes a
@@ -84,8 +94,7 @@ class ViewChangeTriggerService:
 
     def process_primary_disconnected(self, msg: PrimaryDisconnected) -> None:
         self._send_instance_change(
-            self._data.view_no + 1, Suspicions.get_by_code(21)
-            or Suspicions.VIEW_CHANGE_WRONG)
+            self._data.view_no + 1, Suspicions.PRIMARY_DISCONNECTED)
 
     def _send_instance_change(self, view_no: int, suspicion) -> None:
         code = getattr(suspicion, "code", 0)

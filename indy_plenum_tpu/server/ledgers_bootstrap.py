@@ -84,8 +84,13 @@ class LedgersBootstrap:
         self.nym_handler = NymHandler(self.db)
         self.node_handler = NodeHandler(
             self.db, get_nym_data=self.nym_handler.get_nym_data)
+        from .request_handlers.pool_config_handler import PoolConfigHandler
+
+        self.pool_config_handler = PoolConfigHandler(
+            self.db, get_nym_data=self.nym_handler.get_nym_data)
         self.write_manager.register_req_handler(self.nym_handler)
         self.write_manager.register_req_handler(self.node_handler)
+        self.write_manager.register_req_handler(self.pool_config_handler)
         for lid in STATEFUL_LEDGERS:
             self.write_manager.register_batch_handler(
                 LedgerBatchHandler(self.db, lid))

@@ -131,7 +131,8 @@ class Node:
                  vote_plane=None,
                  drive_quorum_ticks: bool = True,
                  num_instances: int = 1,
-                 metrics=None):
+                 metrics=None,
+                 backup_vote_plane_factory=None):
         self.name = name
         self.config = config or getConfig()
         self.timer = timer
@@ -285,7 +286,8 @@ class Node:
         self.leecher = NodeLeecherService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, timer=timer, bootstrap=self.boot,
-            config=self.config, suspicion_sink=catchup_suspicion)
+            config=self.config, suspicion_sink=catchup_suspicion,
+            metrics=self.metrics)
 
         # --- RBFT: monitor + backup instances ----------------------------
         from ..common.messages.internal_messages import (
@@ -308,7 +310,8 @@ class Node:
                 bound=10 * self.config.LOG_SIZE),
             on_backup_ordered=self._on_backup_ordered,
             forward_request_propagates=self._on_request_propagates,
-            num_instances=num_instances)
+            num_instances=num_instances,
+            vote_plane_factory=backup_vote_plane_factory)
         if num_instances > 1:
             self.replicas.build(0, self.data.primaries)
         self.internal_bus.subscribe(ViewChangeStarted,
@@ -375,6 +378,10 @@ class Node:
         self.vote_plane.sync()
         self.ordering.service_quorum_tick()
         self.checkpoints.service_quorum_tick()
+        for backup in self.replicas.backups:
+            if backup.vote_plane is not None:
+                backup.ordering.service_quorum_tick()
+                backup.checkpoints.service_quorum_tick()
 
     # ------------------------------------------------------------------
     # client ingress
@@ -396,6 +403,14 @@ class Node:
         if self.action_manager.is_action(req.txn_type):
             return self._handle_action_request(req, client_id)
         if self.read_manager.is_read(req.txn_type):
+            if not self.data.is_participating:
+                # fail closed: while catching up (or after a FAILED catchup
+                # with convicted history) our committed state is not
+                # trustworthy — never answer reads from it
+                self._to_client(client_id, RequestNack(
+                    identifier=req.identifier, reqId=req.reqId,
+                    reason="node is catching up; reads unavailable"))
+                return False
             try:
                 result = self.read_manager.handle(req)
             except InvalidClientRequest as ex:
@@ -413,6 +428,17 @@ class Node:
             result.update(identifier=req.identifier, reqId=req.reqId)
             self._to_client(client_id, Reply(result=result))
             return True
+        # pool-wide write switch (config ledger, POOL_CONFIG): when a
+        # trustee disabled writes, every node NACKs write ingress — except
+        # POOL_CONFIG itself, or the pool could never be re-enabled
+        from ..common.constants import POOL_CONFIG
+
+        if req.txn_type != POOL_CONFIG \
+                and not self.boot.pool_config_handler.writes_enabled():
+            self._to_client(client_id, RequestNack(
+                identifier=req.identifier, reqId=req.reqId,
+                reason="pool writes are disabled (POOL_CONFIG)"))
+            return False
         seen = self.req_idr_to_txn.get_by_payload_digest(req.payload_digest)
         if seen is not None:
             lid, seq = seen
@@ -537,29 +563,34 @@ class Node:
         self.monitor.requests_ordered(inst_id, list(ordered.reqIdr))
 
     def _on_membership_changed(self, validators: List[str],
-                               registry: Dict[str, dict]) -> None:
-        """A committed NODE txn changed the validator set: quorums and the
-        BLS register are already updated (PoolManager); the composition
-        reacts to the rest (transport connects, vote-plane axis)."""
-        primary = self.data.primary_name
-        if primary is not None and primary not in validators:
-            # the master primary was demoted: it must not keep minting
-            # batches the pool accepts — vote it out now (reference:
-            # plenum starts a view change when the primary leaves the set)
-            from ..common.messages.internal_messages import (
-                VoteForViewChange,
-            )
-            from .suspicion_codes import Suspicions
+                               registry: Dict[str, dict],
+                               set_changed: bool = True) -> None:
+        """A committed NODE txn changed the pool. ``set_changed`` is False
+        for record-only changes (key/address rotation): those rewire the
+        transport but must NOT tear down backup instances or reset the
+        monitor — a stream of rotation txns would otherwise keep the
+        degradation detector's baselines permanently empty."""
+        if set_changed:
+            primary = self.data.primary_name
+            if primary is not None and primary not in validators:
+                # the master primary was demoted: it must not keep minting
+                # batches the pool accepts — vote it out now (reference:
+                # plenum starts a view change when the primary leaves the
+                # set)
+                from ..common.messages.internal_messages import (
+                    VoteForViewChange,
+                )
+                from .suspicion_codes import Suspicions
 
-            logger.info("%s: primary %s demoted -> vote view change",
-                        self.name, primary)
-            self.internal_bus.send(VoteForViewChange(
-                suspicion=Suspicions.PRIMARY_DEMOTED))
-        if self.num_instances > 1 and self.replicas.backups:
-            # live backup instances still hold the old validator set (and
-            # would discard the new member's votes) — rebuild them now
-            self.replicas.build(self.data.view_no, self.data.primaries)
-            self.monitor.reset(self.num_instances)
+                logger.info("%s: primary %s demoted -> vote view change",
+                            self.name, primary)
+                self.internal_bus.send(VoteForViewChange(
+                    suspicion=Suspicions.PRIMARY_DEMOTED))
+            if self.num_instances > 1 and self.replicas.backups:
+                # live backup instances still hold the old validator set
+                # (and would discard the new member's votes) — rebuild
+                self.replicas.build(self.data.view_no, self.data.primaries)
+                self.monitor.reset(self.num_instances)
         if self.on_membership_changed_hook is not None:
             self.on_membership_changed_hook(validators, registry)
 

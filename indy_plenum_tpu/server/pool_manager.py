@@ -78,17 +78,24 @@ class PoolManager:
     def refresh_from_ledger(self, pool_ledger) -> None:
         """Re-absorb the whole committed pool ledger (post-catchup: txns
         fetched by the leecher bypass the execution hook)."""
+        before = self._snapshot()
         for _, txn in pool_ledger.get_all_txn():
             self._absorb(txn)
         if self.registry:
-            self._reconfigure(notify=True)
+            self._reconfigure(notify=True,
+                              records_changed=self._snapshot() != before)
 
     def process_committed_txn(self, txn: Dict[str, Any]) -> None:
         """Feed from execution: a NODE txn just committed on this node."""
         if get_type(txn) != NODE:
             return
+        before = self._snapshot()
         self._absorb(txn)
-        self._reconfigure(notify=True)
+        self._reconfigure(notify=True,
+                          records_changed=self._snapshot() != before)
+
+    def _snapshot(self) -> Dict[str, dict]:
+        return {alias: dict(rec) for alias, rec in self.registry.items()}
 
     def _absorb(self, txn: Dict[str, Any]) -> None:
         if get_type(txn) != NODE:
@@ -102,7 +109,12 @@ class PoolManager:
                   "nym": payload.get(TARGET_NYM)}
         self.registry[alias] = record
 
-    def _reconfigure(self, notify: bool) -> None:
+    def _reconfigure(self, notify: bool,
+                     records_changed: bool = False) -> None:
+        """``records_changed``: a NODE txn altered a record WITHOUT
+        changing the active set — key/address rotation. The composition
+        hook must still fire (peers restart that connection with the new
+        key), even though quorums are untouched."""
         new_validators = self.validators
         if not new_validators:
             logger.warning("%s: pool ledger yields an EMPTY validator set; "
@@ -116,8 +128,10 @@ class PoolManager:
                         (len(new_validators) - 1) // 3)
             self._data.set_validators(new_validators)
         self._sync_bls_keys()
-        if changed and notify and self._on_changed is not None:
-            self._on_changed(new_validators, dict(self.registry))
+        if (changed or records_changed) and notify \
+                and self._on_changed is not None:
+            self._on_changed(new_validators, dict(self.registry),
+                             set_changed=changed)
 
     def _sync_bls_keys(self) -> None:
         if self._bls_register is None:

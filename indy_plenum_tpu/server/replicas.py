@@ -16,10 +16,12 @@ view change backups are torn down and rebuilt for the new view (reference:
 Replicas.remove_replica/grow on view change), restarting their
 measurements with the new primaries.
 
-TPU note: backups run host-dict quorum tallies. The device plane's member
-axis (tpu.vote_plane.VotePlaneGroup) extends to (node x instance) members
-naturally, but the master is the only instance whose certificates gate
-execution, so device placement starts there.
+TPU note: with a ``vote_plane_factory`` the backups' quorum tallies ride
+the device plane's (node x instance) member axis
+(tpu.vote_plane.VotePlaneGroup) in the SAME vmapped dispatch as the
+master's — the RBFT instance axis is a leading tensor dimension, so the
+monitor's baseline is measured against an equally-fast tally path (SURVEY
+§2.6 TPU mapping). Without a factory, backups fall back to host dicts.
 """
 from __future__ import annotations
 
@@ -53,7 +55,8 @@ class BackupReplica:
                  config,
                  requests_pool,
                  on_ordered: Callable[[Ordered], None],
-                 forward_request_propagates: Optional[Callable] = None):
+                 forward_request_propagates: Optional[Callable] = None,
+                 vote_plane=None):
         self.inst_id = inst_id
         self.data = ConsensusSharedData(
             node_name, validators, inst_id=inst_id, is_master=False,
@@ -64,13 +67,16 @@ class BackupReplica:
         self.stasher = StashingRouter(
             limit=1000, buses=[self.internal_bus, external_bus])
         self.requests_pool = requests_pool
+        self.vote_plane = vote_plane
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=external_bus, stasher=self.stasher,
-            executor=None, requests=requests_pool, config=config)
+            executor=None, requests=requests_pool, config=config,
+            vote_plane=vote_plane)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
-            network=external_bus, stasher=self.stasher, config=config)
+            network=external_bus, stasher=self.stasher, config=config,
+            vote_plane=vote_plane)
         self._on_ordered = on_ordered
         self.internal_bus.subscribe(Ordered, self._handle_ordered)
         if forward_request_propagates is not None:
@@ -101,7 +107,8 @@ class Replicas:
                  make_requests_pool: Callable[[], object],
                  on_backup_ordered: Callable[[int, Ordered], None],
                  forward_request_propagates: Optional[Callable] = None,
-                 num_instances: Optional[int] = None):
+                 num_instances: Optional[int] = None,
+                 vote_plane_factory: Optional[Callable] = None):
         self._node_name = node_name
         # a list, or a zero-arg provider of the CURRENT validator set —
         # rebuilt backups must see live membership, not the boot-time list
@@ -113,6 +120,10 @@ class Replicas:
         self._make_requests_pool = make_requests_pool
         self._on_backup_ordered = on_backup_ordered
         self._forward_request_propagates = forward_request_propagates
+        # inst_id -> DeviceVotePlane view: backups' tallies ride the SAME
+        # vmapped (node x instance) group dispatch as the master's (SURVEY
+        # §2.6's TPU mapping: instances = leading axis on the vote tensors)
+        self._vote_plane_factory = vote_plane_factory
         # instance count the NODE was sized for (monitor slots, primaries
         # list length) — not re-derived here, or the two could disagree
         self._num_instances = (
@@ -128,12 +139,20 @@ class Replicas:
         """(Re)create backups for ``view_no`` with CURRENT membership."""
         self.teardown()
         for inst_id in range(1, self._num_instances):
+            plane = None
+            if self._vote_plane_factory is not None:
+                plane = self._vote_plane_factory(inst_id)
+                if plane is not None:
+                    # a rebuilt instance must not inherit the old view's
+                    # votes (the master's plane resets on view change too)
+                    plane.reset(h=0)
             replica = BackupReplica(
                 self._node_name, self._validators(), inst_id, view_no,
                 primaries, self._timer, self._external_bus, self._config,
                 requests_pool=self._make_requests_pool(),
                 on_ordered=lambda o, i=inst_id: self._on_backup_ordered(i, o),
-                forward_request_propagates=self._forward_request_propagates)
+                forward_request_propagates=self._forward_request_propagates,
+                vote_plane=plane)
             replica.start()
             self.backups.append(replica)
         logger.debug("%s built %d backup instance(s) for view %d",

@@ -79,6 +79,22 @@ class WriteRequestManager:
 
     def dynamic_validation(self, request: Request,
                            req_pp_time: Optional[int]) -> None:
+        # pool-wide write switch, enforced IN CONSENSUS (not only at
+        # ingress): a request smuggled in through a faulty node's
+        # PROPAGATE must still be rejected by every honest replica's
+        # dynamic validation, deterministically (uncommitted state).
+        # POOL_CONFIG itself stays writable or the pool could never
+        # re-enable.
+        from ...common.constants import POOL_CONFIG
+        from ...common.exceptions import UnauthorizedClientRequest
+
+        if request.txn_type != POOL_CONFIG:
+            cfg = self.handlers.get(POOL_CONFIG)
+            if cfg is not None and not cfg.writes_enabled(
+                    is_committed=False):
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "pool writes are disabled (POOL_CONFIG)")
         self._handler(request).dynamic_validation(request, req_pp_time)
 
     # --- apply (staged) -------------------------------------------------

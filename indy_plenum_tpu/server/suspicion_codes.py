@@ -43,10 +43,15 @@ class Suspicions:
         25, "master primary degraded (throughput/latency vs backups)")
     PRIMARY_DEMOTED = Suspicion(
         26, "master primary left the validator set (NODE txn demotion)")
+    PRIMARY_DISCONNECTED = Suspicion(
+        27, "primary unreachable past ToleratePrimaryDisconnection")
     SEQ_NO_OLD = Suspicion(30, "3PC message below watermark")
     SEQ_NO_FUTURE = Suspicion(31, "3PC message above watermark")
     CATCHUP_REP_WRONG = Suspicion(40, "CATCHUP_REP txns fail audit proof")
     LEDGER_STATUS_WRONG = Suspicion(41, "LEDGER_STATUS inconsistent")
+    CATCHUP_FAILED = Suspicion(
+        42, "catchup failed after divergence conviction; node stays "
+            "non-participating (fail-closed) and retries on backoff")
     PROPAGATE_DIGEST_WRONG = Suspicion(50, "PROPAGATE digest != request digest")
 
     @classmethod

@@ -77,14 +77,35 @@ class NodePool:
 
         from .quorum_driver import drive_group_ticks, make_vote_group
 
+        # resolve the instance count the same way Node does, so the
+        # (node x instance) group axis matches the replicas actually built
+        resolved_instances = (num_instances if num_instances > 0
+                              else self.config.replicas_count(n_nodes))
+        self.num_instances = resolved_instances
         self.vote_group = None
         if device_quorum:
             self.vote_group = make_vote_group(
-                n_nodes, self.validators, self.config)
+                n_nodes, self.validators, self.config,
+                num_instances=resolved_instances)
+
+        tick_mode = self.config.QuorumTickInterval > 0
+
+        def backup_plane_factory(node_idx: int):
+            if self.vote_group is None:
+                return None
+
+            def factory(inst_id: int):
+                plane = self.vote_group.view(
+                    node_idx * resolved_instances + inst_id)
+                plane.defer_flush_on_query = tick_mode
+                return plane
+
+            return factory
 
         self.nodes: List[Node] = []
         for i, name in enumerate(self.validators):
-            plane = self.vote_group.view(i) if self.vote_group else None
+            plane = (self.vote_group.view(i * resolved_instances)
+                     if self.vote_group else None)
             node = Node(
                 name, self.validators, self.timer, self.network,
                 config=self.config, domain_genesis=domain_genesis,
@@ -92,7 +113,8 @@ class NodePool:
                               if self.pool_genesis else None),
                 seed_keys=dict(seed_keys), bls_keys=self.bls_keys,
                 vote_plane=plane, num_instances=num_instances,
-                drive_quorum_ticks=False)  # the pool drives group ticks
+                drive_quorum_ticks=False,  # the pool drives group ticks
+                backup_vote_plane_factory=backup_plane_factory(i))
             self.nodes.append(node)
         self.network.connect_all()
         for node in self.nodes:

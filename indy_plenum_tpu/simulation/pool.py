@@ -331,15 +331,23 @@ class SimPool:
                  real_execution: bool = False,
                  sign_requests: bool = False,
                  bls: bool = False,
-                 shadow_check: Optional[bool] = None):
+                 shadow_check: Optional[bool] = None,
+                 num_instances: int = 1):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
         self.network = SimNetwork(self.timer, seed=seed)
         self.validators = [f"node{i}" for i in range(n_nodes)]
+        # RBFT: f+1 parallel protocol instances (0 = auto f+1); backup
+        # instances get their own finalised-request queue per (node, inst)
+        if num_instances <= 0:
+            num_instances = self.config.replicas_count(n_nodes)
+        self.num_instances = num_instances
         self.requests = SimRequestsPool()
         for name in self.validators:
             self.requests.register_node(name)
+            for inst in range(1, num_instances):
+                self.requests.register_node(f"{name}#{inst}")
 
         self.real_execution = real_execution
         self.sign_requests = sign_requests
@@ -379,17 +387,52 @@ class SimPool:
         self.vote_group = None
         if device_quorum:
             self.vote_group = make_vote_group(
-                n_nodes, self.validators, self.config)
+                n_nodes, self.validators, self.config,
+                num_instances=num_instances)
 
+        k = num_instances
         self.nodes: List[SimNode] = [
             SimNode(name, self.validators, self.timer, self.network,
                     self.requests, self.config, device_quorum=device_quorum,
                     domain_genesis=domain_genesis if real_execution else None,
                     bls_keys=self.bls_keys, shadow_check=shadow_check,
-                    vote_plane=(self.vote_group.view(i)
+                    vote_plane=(self.vote_group.view(i * k)
                                 if self.vote_group else None))
             for i, name in enumerate(self.validators)]
         self.network.connect_all()
+
+        # backup instances (RBFT): each node i runs instances 1..k-1 over
+        # the shared external bus; device mode puts them on the group's
+        # (node x instance) member axis, same vmapped dispatch as masters
+        if k > 1:
+            import types
+
+            from ..server.consensus.primary_selector import (
+                RoundRobinConstantNodesPrimariesSelector as _Sel,
+            )
+            from ..server.replicas import BackupReplica
+
+            primaries_k = _Sel(self.validators).select_primaries(0, k)
+            tick_mode = self.config.QuorumTickInterval > 0
+            for i, node in enumerate(self.nodes):
+                node.data.primaries = list(primaries_k)
+                backups = []
+                for inst in range(1, k):
+                    plane = None
+                    if self.vote_group is not None:
+                        plane = self.vote_group.view(i * k + inst)
+                        plane.defer_flush_on_query = tick_mode
+                    replica = BackupReplica(
+                        node.name, self.validators, inst, 0, primaries_k,
+                        self.timer, node.external_bus, self.config,
+                        requests_pool=self.requests.view_for(
+                            f"{node.name}#{inst}"),
+                        on_ordered=lambda o: None,
+                        vote_plane=plane)
+                    replica.start()
+                    backups.append(replica)
+                # the shape quorum_driver's tick expects (Node.replicas)
+                node.replicas = types.SimpleNamespace(backups=backups)
 
         # tick-batched quorum mode: ONE group flush per tick serves the
         # whole pool; services evaluate against that snapshot and votes

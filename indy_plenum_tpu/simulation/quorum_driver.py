@@ -14,11 +14,17 @@ from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config
 
 
-def make_vote_group(n_nodes: int, validators, config: Config):
+def make_vote_group(n_nodes: int, validators, config: Config,
+                    num_instances: int = 1):
+    """Member axis = (node x instance): member i*num_instances + inst_id
+    is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
+    mapping — instances are a leading tensor dimension, so backups' vote
+    tallies ride the same vmapped dispatch as the master's)."""
     from ..tpu.vote_plane import VotePlaneGroup
 
     return VotePlaneGroup(
-        n_nodes, list(validators), log_size=config.LOG_SIZE,
+        n_nodes * max(1, num_instances), list(validators),
+        log_size=config.LOG_SIZE,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
 
 
@@ -41,5 +47,10 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
         for node in nodes:
             node.ordering.service_quorum_tick()
             node.checkpoints.service_quorum_tick()
+            replicas = getattr(node, "replicas", None)  # SimNode has none
+            for backup in (replicas.backups if replicas else ()):
+                if backup.vote_plane is not None:
+                    backup.ordering.service_quorum_tick()
+                    backup.checkpoints.service_quorum_tick()
 
     return RepeatingTimer(timer, config.QuorumTickInterval, tick)

@@ -1,4 +1,9 @@
 """Operational tooling: pool provisioning + node runner (CLI back-end)."""
-from .local_pool import build_node, generate_pool_config, run_pool
+from .local_pool import (
+    build_client,
+    build_node,
+    generate_pool_config,
+    run_pool,
+)
 
-__all__ = ["build_node", "generate_pool_config", "run_pool"]
+__all__ = ["build_client", "build_node", "generate_pool_config", "run_pool"]

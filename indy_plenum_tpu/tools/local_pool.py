@@ -66,19 +66,35 @@ def generate_pool_config(directory: str, n_nodes: int = 4,
         steward = DidSigner(derive(f"steward-{i}"))
         node_seed = derive(f"node-{i}")
         public, _secret = curve_keypair_from_seed(node_seed)
+        # the client listener's curve identity (ClientZStack derivation)
+        client_public, _ = curve_keypair_from_seed(
+            hashlib.sha256(b"client-stack" + node_seed).digest())
+        # BLS signing identity: public key + proof of possession go into
+        # the pool genesis NODE txn (reference: init_bls_keys)
+        from ..bls.factory import generate_bls_keys
+
+        _kp, bls_pk, bls_pop = generate_bls_keys(derive(f"bls-{i}"))
         domain.append(genesis_nym_txn(steward.identifier, steward.verkey,
                                       role=STEWARD))
         pool.append(genesis_node_txn(
             node_nym=f"nym-{name}", alias=name,
             steward_did=steward.identifier,
-            node_port=base_port + 2 * i, client_port=base_port + 2 * i + 1))
+            node_port=base_port + 2 * i, client_port=base_port + 2 * i + 1,
+            blskey=bls_pk, blskey_pop=bls_pop,
+            transport_verkey=public.decode()))
         nodes[name] = {
             "transport_public": public.decode(),
+            "client_public": client_public.decode(),
             "node_ip": "127.0.0.1",
             "node_port": base_port + 2 * i,
+            "client_ip": "127.0.0.1",
+            "client_port": base_port + 2 * i + 1,
+            "bls_key": bls_pk,
+            "bls_pop": bls_pop,
         }
         _write_secret(os.path.join(keys_dir, f"{name}.json"),
-                      {"seed": node_seed.hex()})
+                      {"seed": node_seed.hex(),
+                       "bls_seed": derive(f"bls-{i}").hex()})
     _write_secret(os.path.join(keys_dir, "trustee.json"),
                   {"seed": derive("trustee").hex()})
     info = {
@@ -101,9 +117,9 @@ def _write_secret(path: str, payload: Dict) -> None:
         json.dump(payload, fh)
 
 
-def load_secret_seed(directory: str, name: str) -> bytes:
+def load_secret_seed(directory: str, name: str, key: str = "seed") -> bytes:
     with open(os.path.join(directory, KEYS_DIR, f"{name}.json")) as fh:
-        return bytes.fromhex(json.load(fh)["seed"])
+        return bytes.fromhex(json.load(fh)[key])
 
 
 def load_pool_info(directory: str) -> Dict:
@@ -119,11 +135,18 @@ def build_node(directory: str, name: str, looper: Looper,
     config = config or getConfig(
         {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 100,
          "PropagateBatchWait": 0.05})
-    stack = ZStack(name, load_secret_seed(directory, name),
+    node_seed = load_secret_seed(directory, name)
+    # ONE collector per validator, shared by transport and node: HWM drops
+    # (zstack.dropped) land in the same summary as auth/commit timings
+    from ..common.metrics_collector import MetricsCollector
+
+    metrics = MetricsCollector()
+    stack = ZStack(name, node_seed,
                    bind_host=record["node_ip"],
                    bind_port=record["node_port"],
                    max_batch=config.OUTGOING_BATCH_SIZE,
-                   msg_len_limit=config.MSG_LEN_LIMIT)
+                   msg_len_limit=config.MSG_LEN_LIMIT,
+                   metrics=metrics)
     for peer, rec in info["nodes"].items():
         if peer == name:
             continue
@@ -131,14 +154,40 @@ def build_node(directory: str, name: str, looper: Looper,
         stack.allow_peer(peer, key)
         stack.connect(peer, (rec["node_ip"], rec["node_port"]), key)
     net = ZStackNetwork(stack)
+
+    # BLS: own keypair from the secret file, pool publics from pool info
+    bls_keys = None
+    if all("bls_key" in rec for rec in info["nodes"].values()):
+        from ..bls.factory import generate_bls_keys
+
+        own_kp, _, _ = generate_bls_keys(
+            load_secret_seed(directory, name, key="bls_seed"))
+        bls_keys = {
+            peer: (own_kp if peer == name else None,
+                   rec["bls_key"], rec["bls_pop"])
+            for peer, rec in info["nodes"].items()}
+
     node = Node(
         name, list(info["validators"]), looper.timer, net, config=config,
         pool_genesis=load_genesis_file(
             os.path.join(directory, POOL_GENESIS)),
         domain_genesis=load_genesis_file(
             os.path.join(directory, DOMAIN_GENESIS)),
-        seed_keys={info["trustee_did"]: info["trustee_verkey"]})
+        seed_keys={info["trustee_did"]: info["trustee_verkey"]},
+        bls_keys=bls_keys, metrics=metrics)
     net.mark_connected(set(info["validators"]) - {name})
+    # committed NODE txns rewire the transport (KIT semantics): new
+    # members get connected, departed ones dropped, rotated keys restart
+    node.on_membership_changed_hook = net.membership_hook
+
+    # the client-facing listener (reference: the node's client stack)
+    from ..network.client_stack import ClientZStack, NodeClientSurface
+
+    client_stack = ClientZStack(
+        name, node_seed, bind_host=record.get("client_ip", "127.0.0.1"),
+        bind_port=record.get("client_port", 0),
+        msg_len_limit=config.MSG_LEN_LIMIT)
+    node.client_surface = NodeClientSurface(node, client_stack)
     return node, stack
 
 
@@ -154,6 +203,37 @@ def run_pool(directory: str, names: Optional[List[str]] = None,
         node, stack = build_node(directory, name, looper, config=config)
         node.start()
         looper.add(stack)
+        looper.add(node.client_surface)
         nodes.append(node)
         stacks.append(stack)
     return looper, nodes, stacks
+
+
+def build_client(directory: str, name: str = "client1",
+                 now_provider=None):
+    """A pool client over real sockets: Client logic + PoolClientStack
+    transport wired together. Pump ``client.stack.service()`` (or add the
+    returned stack to a Looper) to move messages."""
+    import time as _time
+
+    from ..client.client import Client
+    from ..network.client_stack import PoolClientStack
+
+    info = load_pool_info(directory)
+    nodes = {
+        node_name: ((rec.get("client_ip", "127.0.0.1"),
+                     rec["client_port"]),
+                    rec["client_public"].encode())
+        for node_name, rec in info["nodes"].items()
+        if "client_port" in rec and "client_public" in rec}
+    stack = PoolClientStack(name, nodes)
+    bls_keys = {n: rec["bls_key"] for n, rec in info["nodes"].items()
+                if "bls_key" in rec}
+    client = Client(
+        name, list(info["validators"]),
+        send=lambda req, node_name, _cid: stack.send(req, node_name),
+        pool_bls_keys=bls_keys,
+        now_provider=now_provider or _time.time)
+    stack.on_message = client.process_node_message
+    client.stack = stack
+    return client, stack

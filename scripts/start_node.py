@@ -25,7 +25,9 @@ def main() -> int:
     node, stack = build_node(directory, name, looper)
     node.start()
     looper.add(stack)
-    print(f"{name} listening on {stack.ha[0]}:{stack.ha[1]} — ^C to stop")
+    looper.add(node.client_surface)
+    print(f"{name} listening on {stack.ha[0]}:{stack.ha[1]} "
+          f"(clients: {node.client_surface.stack.ha[1]}) — ^C to stop")
     try:
         while True:
             looper.run_for(3600)

@@ -32,11 +32,13 @@ CATCHUP_CONFIG = {
 }
 
 
-def make_pool(n=4, seed=0):
+def make_pool(n=4, seed=0, **extra):
     from indy_plenum_tpu.config import getConfig
 
+    cfg = dict(CATCHUP_CONFIG)
+    cfg.update(extra)
     return SimPool(n, seed=seed, real_execution=True,
-                   config=getConfig(dict(CATCHUP_CONFIG)))
+                   config=getConfig(cfg))
 
 
 def domain_sizes(pool):
@@ -226,6 +228,80 @@ def test_checkpoint_divergence_triggers_recovery():
     pool.run_for(8)
     assert len(set(domain_roots(pool))) == 1
     assert len(set(domain_sizes(pool))) == 1
+
+
+def test_failed_catchup_stays_non_participating_and_recovers():
+    """The round-3 fail-open hole, closed: a node whose history is
+    CONVICTED as diverged (f+1 peers) but which cannot repair it (here: the
+    audit truncate is broken, simulating a storage fault) must NOT resume
+    participating — no ordering, no votes — and must alert the operator.
+    When the fault clears, the scheduled backoff retry recovers it."""
+    from indy_plenum_tpu.common.messages.internal_messages import (
+        RaisedSuspicion,
+    )
+    from indy_plenum_tpu.server.suspicion_codes import Suspicions
+
+    pool = make_pool(seed=25, CatchupFailedRetryBackoff=2.0,
+                     CatchupFailedRetryBackoffMax=2.0)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+    assert len(set(domain_roots(pool))) == 1
+
+    evil = pool.node("node1")
+    alerts = []
+    evil.internal_bus.subscribe(
+        RaisedSuspicion, lambda m, *a: alerts.append(m.ex))
+
+    # corrupt: same-length audit+domain with a fake tail (history WRONG,
+    # not merely short) -> cons-proof conviction, not a plain fetch
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    domain.reset_to(domain.size - 1)
+    domain.add({"fake": 1})
+    audit.reset_to(audit.size - 1)
+    audit.add({"fake_audit": 1})
+    corrupted_root = domain.root_hash
+
+    # the repair path is broken: truncation silently fails, so every
+    # conviction round re-convicts until the leecher gives up
+    real_reset = audit.reset_to
+    audit.reset_to = lambda size: None
+
+    evil.leecher.start()
+    pool.run_for(10)
+
+    # FAIL CLOSED: convicted + unrepairable => out of the protocol
+    assert evil.leecher.catchups_failed >= 1
+    assert evil.data.is_participating is False
+    assert any(getattr(ex, "suspicion", None) is Suspicions.CATCHUP_FAILED
+               for ex in alerts)
+
+    # the pool keeps ordering without it; the convicted node must not
+    # order (and therefore not vote) from state it knows is wrong
+    ordered_before = len(evil.ordered_log)
+    for i in range(50, 53):
+        pool.submit_request(i)
+    pool.run_for(8)
+    honest = pool.node("node0")
+    assert honest.boot.db.get_ledger(DOMAIN_LEDGER_ID).size > domain.size
+    assert len(evil.ordered_log) == ordered_before
+    assert domain.root_hash == corrupted_root  # untouched, not fail-open
+    assert evil.data.is_participating is False
+
+    # fault clears -> the backoff retry (already scheduled) resyncs it
+    audit.reset_to = real_reset
+    pool.run_for(10)
+    assert evil.data.is_participating is True
+    assert len(set(domain_roots(pool))) == 1
+    assert len(set(domain_sizes(pool))) == 1
+    # and it is live again for NEW traffic
+    pre = min(domain_sizes(pool))
+    for i in range(200, 203):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert domain_sizes(pool) == [pre + 3] * 4
+    assert len(set(domain_roots(pool))) == 1
 
 
 def test_ledger_reset_to():

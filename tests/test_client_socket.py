@@ -1,0 +1,132 @@
+"""Tier 3: a pool client over REAL sockets (VERDICT r3 item 3).
+
+The client talks to the pool exclusively through each node's client-facing
+ClientZStack listener (reference: stp_zmq/simple_zstack.py +
+client_message_provider.py): signed NYM write -> f+1 matching REPLYs;
+proved GET_NYM read -> one node's answer verified against the pool's BLS
+keys; forged signature -> REQNACK. The pool itself is the provisioned
+`scripts/start_node.py` composition (tools.local_pool.run_pool), with BLS
+on — so this is also the socket-tier BLS composition test.
+"""
+import hashlib
+
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    GET_NYM,
+    NYM,
+    TARGET_NYM,
+    TXN_TYPE,
+    VERKEY,
+)
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.crypto.signers import DidSigner
+from indy_plenum_tpu.tools import build_client, generate_pool_config
+from indy_plenum_tpu.tools.local_pool import load_secret_seed, run_pool
+
+
+@pytest.fixture(scope="module")
+def socket_pool(tmp_path_factory):
+    directory = str(tmp_path_factory.mktemp("client-socket-pool"))
+    from indy_plenum_tpu.config import getConfig
+
+    generate_pool_config(directory, n_nodes=4, base_port=17800,
+                         master_seed=b"\x21" * 32)
+    config = getConfig({"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.02})
+    looper, nodes, stacks = run_pool(directory, config=config)
+    trustee = DidSigner(load_secret_seed(directory, "trustee"))
+    # warm the device verify kernel OUTSIDE liveness budgets
+    probe = Request(identifier=trustee.identifier, reqId=0,
+                    operation={TXN_TYPE: NYM, TARGET_NYM: "warmup"})
+    trustee.sign_request(probe)
+    assert nodes[0].authnr.authenticate_batch([probe]).all()
+    yield directory, looper, nodes, trustee
+    looper.shutdown()
+    for node in nodes:
+        node.stop()
+        node.client_surface.close()
+    for stack in stacks:
+        stack.close()
+
+
+def make_nym(trustee, tag: str, req_id: int) -> Request:
+    target = DidSigner(hashlib.sha256(tag.encode()).digest())
+    req = Request(identifier=trustee.identifier, reqId=req_id,
+                  operation={TXN_TYPE: NYM, TARGET_NYM: target.identifier,
+                             VERKEY: target.verkey})
+    trustee.sign_request(req)
+    return req
+
+
+def test_client_write_collects_f_plus_1_replies_over_sockets(socket_pool):
+    directory, looper, nodes, trustee = socket_pool
+    client, stack = build_client(directory, "cli-write")
+    looper.add(stack)
+    try:
+        req = make_nym(trustee, "sock-client-1", 1)
+        digest = client.submit_write(req)
+        ok = looper.run_until(lambda: client.result(digest) is not None,
+                              timeout=30)
+        assert ok, client.pending[digest].nacks
+        state = client.pending[digest]
+        assert len(state.replies) >= 2  # f+1 distinct nodes
+        assert state.result["txnMetadata"]["seqNo"] >= 1
+        # the NYM executed on every node
+        for node in nodes:
+            assert node.get_nym_data(req.operation["dest"]) is not None
+    finally:
+        looper.remove(stack)
+        stack.close()
+
+
+def test_client_proved_read_over_sockets(socket_pool):
+    """One node's GET_NYM answer suffices: the reply's SMT proof + pool
+    BLS multi-signature verify on the client side."""
+    directory, looper, nodes, trustee = socket_pool
+    client, stack = build_client(directory, "cli-read")
+    looper.add(stack)
+    try:
+        req = make_nym(trustee, "sock-client-2", 2)
+        digest = client.submit_write(req)
+        assert looper.run_until(
+            lambda: client.result(digest) is not None, timeout=30)
+
+        read = Request(identifier="reader", reqId=100,
+                       operation={TXN_TYPE: GET_NYM,
+                                  TARGET_NYM: req.operation["dest"]})
+        # ask exactly ONE node — a proved read needs no quorum
+        rdigest = client.submit_read(read, to="node2")
+        assert looper.run_until(
+            lambda: client.result(rdigest) is not None, timeout=30)
+        assert rdigest in client.proved_reads
+        result = client.proved_reads[rdigest]
+        assert result["dest"] == req.operation["dest"]
+        # the SMT value is the msgpack NYM record; the proof verified
+        # these exact bytes, decoding is presentation only
+        import msgpack
+
+        record = msgpack.unpackb(result["data"], raw=False)
+        assert record["verkey"] == req.operation["verkey"]
+    finally:
+        looper.remove(stack)
+        stack.close()
+
+
+def test_client_forged_signature_nacked_over_sockets(socket_pool):
+    directory, looper, nodes, trustee = socket_pool
+    client, stack = build_client(directory, "cli-forge")
+    looper.add(stack)
+    try:
+        req = make_nym(trustee, "sock-client-3", 3)
+        req.operation["evil"] = True  # signature no longer covers payload
+        digest = client.submit_write(req)
+        ok = looper.run_until(
+            lambda: len(client.pending[digest].nacks) >= 2, timeout=30)
+        assert ok
+        assert client.result(digest) is None
+        assert any("signature" in reason
+                   for reason in client.pending[digest].nacks.values())
+    finally:
+        looper.remove(stack)
+        stack.close()

@@ -1,0 +1,163 @@
+"""Config-ledger write path (VERDICT r3 item 6).
+
+Reference: config-ledger request handlers under
+plenum/server/request_handlers/ + config_batch_handler.py (+ the
+indy-node pool_config ``writes`` semantics). A committed POOL_CONFIG txn
+must observably change behaviour on EVERY node, survive restart, and
+reach lagging nodes through catchup.
+"""
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    CONFIG_LEDGER_ID,
+    POOL_CONFIG,
+    TXN_TYPE,
+    WRITES,
+)
+from indy_plenum_tpu.common.messages.node_messages import RequestNack
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+
+def make_pool_config(signer, writes: bool, req_id: int) -> Request:
+    req = Request(identifier=signer.identifier, reqId=req_id,
+                  operation={TXN_TYPE: POOL_CONFIG, WRITES: writes})
+    signer.sign_request(req)
+    return req
+
+
+def config_sizes(pool):
+    return [n.boot.db.get_ledger(CONFIG_LEDGER_ID).size for n in pool.nodes]
+
+
+def test_pool_config_write_disables_and_reenables_writes():
+    """The full lifecycle: a trustee's POOL_CONFIG {writes: false} orders
+    through 3PC onto the config ledger and every node then NACKs write
+    ingress; {writes: true} restores service (POOL_CONFIG itself is exempt
+    from the gate, or the pool could never recover)."""
+    pool = NodePool(4, seed=61)
+    off = make_pool_config(pool.trustee, False, 1)
+    assert pool.submit_to("node0", off)
+    pool.run_for(15)
+    assert config_sizes(pool) == [1] * 4
+    for node in pool.nodes:
+        assert node.boot.pool_config_handler.writes_enabled() is False
+
+    # writes now NACK at ingress on EVERY node
+    for i, node in enumerate(pool.nodes):
+        req = pool.make_nym_request()
+        assert node.submit_client_request(req, client_id="c") is False
+        nack = node.client_outbox[-1][1]
+        assert isinstance(nack, RequestNack)
+        assert "disabled" in nack.reason
+    pool.run_for(5)
+    assert all(len(n.ordered_digests) == 1 for n in pool.nodes)
+
+    # a trustee can still re-enable (the exemption)
+    on = make_pool_config(pool.trustee, True, 2)
+    assert pool.submit_to("node1", on)
+    pool.run_for(15)
+    assert config_sizes(pool) == [2] * 4
+    for node in pool.nodes:
+        assert node.boot.pool_config_handler.writes_enabled() is True
+    req = pool.make_nym_request()
+    assert pool.submit_to("node2", req)
+    pool.run_for(15)
+    assert all(node.get_nym_data(req.operation["dest"]) is not None
+               for node in pool.nodes)
+
+
+def test_pool_config_requires_trustee():
+    """A known-but-unprivileged identity fails the config auth rule in
+    dynamic validation: nothing commits, the flag stays on."""
+    pool = NodePool(4, seed=62)
+    # onboard a plain identity (no role), who then tries to flip the pool
+    req = pool.make_nym_request()
+    target = req.target_signer
+    pool.submit_to("node0", req)
+    pool.run_for(15)
+    assert all(n.get_nym_data(target.identifier) is not None
+               for n in pool.nodes)
+
+    rogue = make_pool_config(target, False, 1)
+    pool.submit_to("node0", rogue)
+    pool.run_for(15)
+    assert config_sizes(pool) == [0] * 4
+    for node in pool.nodes:
+        assert node.boot.pool_config_handler.writes_enabled() is True
+    # and the pool still accepts writes
+    req2 = pool.make_nym_request()
+    assert pool.submit_to("node3", req2)
+    pool.run_for(15)
+    assert all(n.get_nym_data(req2.operation["dest"]) is not None
+               for n in pool.nodes)
+
+
+def test_pool_config_survives_restart():
+    """The flag lives in config STATE derived from the config LEDGER:
+    reopening the same stores (the restart path) rebuilds it."""
+    from indy_plenum_tpu.server.ledgers_bootstrap import LedgersBootstrap
+
+    pool = NodePool(4, seed=63)
+    off = make_pool_config(pool.trustee, False, 1)
+    pool.submit_to("node0", off)
+    pool.run_for(15)
+    node = pool.nodes[2]
+    assert node.boot.pool_config_handler.writes_enabled() is False
+
+    reopened = LedgersBootstrap(storage=node.boot.storage).build()
+    assert reopened.db.get_ledger(CONFIG_LEDGER_ID).size == 1
+    assert reopened.pool_config_handler.writes_enabled() is False
+
+
+def test_pool_config_reaches_lagging_node_via_catchup():
+    """A node that missed the config write learns it through catchup and
+    starts NACKing writes like everyone else."""
+    config = None
+    pool = NodePool(4, seed=64)
+    behind = pool.node("node3")
+    pool.network.disconnect("node3")
+
+    off = make_pool_config(pool.trustee, False, 1)
+    pool.submit_to("node0", off)
+    pool.run_for(15)
+    assert behind.boot.db.get_ledger(CONFIG_LEDGER_ID).size == 0
+    assert behind.boot.pool_config_handler.writes_enabled() is True
+
+    pool.network.reconnect("node3")
+    behind.leecher.start()
+    pool.run_for(15)
+    assert behind.boot.db.get_ledger(CONFIG_LEDGER_ID).size == 1
+    assert behind.boot.pool_config_handler.writes_enabled() is False
+    req = pool.make_nym_request()
+    assert behind.submit_client_request(req, client_id="c") is False
+    assert "disabled" in behind.client_outbox[-1][1].reason
+
+
+def test_writes_disabled_enforced_in_consensus_not_just_ingress():
+    """Bypass resistance (review finding): a request smuggled past the
+    ingress gate — e.g. via a faulty node's PROPAGATE — is still rejected
+    by every replica's dynamic validation while writes are disabled, so
+    nothing commits anywhere."""
+    pool = NodePool(4, seed=65)
+    off = make_pool_config(pool.trustee, False, 1)
+    pool.submit_to("node0", off)
+    pool.run_for(15)
+    assert all(not n.boot.pool_config_handler.writes_enabled()
+               for n in pool.nodes)
+
+    # smuggle: finalise a NYM write directly on every node (the state a
+    # byzantine ingress could produce), skipping submit_client_request
+    req = pool.make_nym_request()
+    for node in pool.nodes:
+        node._on_request_finalised(req)
+    pool.run_for(15)
+    from indy_plenum_tpu.common.constants import DOMAIN_LEDGER_ID
+
+    # the batch ordered (consensus is live) but the txn was rejected by
+    # dynamic validation on every replica: no domain append, no NYM
+    for node in pool.nodes:
+        assert node.get_nym_data(req.operation["dest"]) is None
+    sizes = {n.boot.db.get_ledger(DOMAIN_LEDGER_ID).size
+             for n in pool.nodes}
+    assert len(sizes) == 1  # and they all agree

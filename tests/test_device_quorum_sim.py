@@ -125,3 +125,29 @@ def test_tick_batched_survives_view_change():
     logs = [tuple(n.ordered_digests) for n in survivors]
     assert len(set(logs)) == 1
     assert len(logs[0]) == 9
+
+
+def test_sim_pool_rbft_instances_on_device_plane():
+    """SimPool's RBFT instance axis (the bench's full-RBFT config at
+    miniature scale): f+1 instances per node, every backup's tallies on
+    the shared (node x instance) device group, one flush wave per tick."""
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.simulation.pool import SimPool
+
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                     "QuorumTickInterval": 0.05})
+    pool = SimPool(4, seed=5, config=cfg, device_quorum=True,
+                   shadow_check=False, num_instances=0)  # auto f+1 = 2
+    assert pool.num_instances == 2
+    for n in pool.nodes:
+        assert len(n.replicas.backups) == 1
+        assert n.replicas.backups[0].vote_plane is not None
+    for i in range(6):
+        pool.submit_request(i)
+    pool.run_for(25)
+    assert all(len(n.ordered_digests) == 6 for n in pool.nodes)
+    assert pool.honest_nodes_agree()
+    # the backup instance (primary node1) ordered the same traffic
+    for n in pool.nodes:
+        assert n.replicas.backups[0].data.last_ordered_3pc[1] >= 1
+    assert pool.vote_group.flushes > 0

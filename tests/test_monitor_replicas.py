@@ -123,3 +123,47 @@ def test_backups_rebuilt_after_view_change():
         # rebuilt for the new view with the new primaries
         assert backup.data.view_no == n.data.view_no
         assert backup.data.primaries == n.data.primaries
+
+
+def test_backups_order_on_device_plane():
+    """VERDICT r3 item 4: the RBFT instance axis reaches the device — with
+    device_quorum on, each backup instance's quorum tallies ride the SAME
+    vmapped (node x instance) group dispatch as the master's, and both
+    instances still order under their different primaries."""
+    pool = NodePool(4, seed=14, num_instances=0, device_quorum=True)
+    assert pool.num_instances == 2
+    # every backup got a live member plane from the (node x inst) group
+    for n in pool.nodes:
+        assert len(n.replicas.backups) == 1
+        assert n.replicas.backups[0].vote_plane is not None
+        assert n.replicas.backups[0].vote_plane is not n.vote_plane
+
+    for _ in range(4):
+        pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(20)
+    for n in pool.nodes:
+        assert len(n.ordered_digests) == 4, n.name
+        backup = n.replicas.backups[0]
+        assert backup.data.last_ordered_3pc[1] >= 1, \
+            (n.name, backup.data.last_ordered_3pc)
+    assert pool.vote_group.flushes > 0
+
+
+def test_backups_order_on_device_plane_tick_mode():
+    """Same instance-axis configuration under tick-batched flushing (the
+    bench's amortized mode): ONE group flush per tick serves every node's
+    master AND backup planes."""
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                        "PropagateBatchWait": 0.05,
+                        "QuorumTickInterval": 0.05})
+    pool = NodePool(4, seed=15, config=config, num_instances=0,
+                    device_quorum=True)
+    for n in pool.nodes:
+        assert n.replicas.backups[0].vote_plane.defer_flush_on_query
+    for i in range(6):
+        pool.submit_to(f"node{i % 4}", pool.make_nym_request())
+    pool.run_for(30)
+    for n in pool.nodes:
+        assert len(n.ordered_digests) == 6, n.name
+        assert n.replicas.backups[0].data.last_ordered_3pc[1] >= 1
+    assert pool.vote_group.flushes > 0

@@ -141,3 +141,39 @@ def test_device_quorum_node_pool_tick_mode():
     assert all_ordered(pool, 6)
     assert pool.honest_nodes_agree()
     assert pool.vote_group.flushes > 0
+
+
+def test_reads_nacked_while_not_participating():
+    """Fail-closed read surface: a node that is catching up (or whose
+    catchup FAILED after a divergence conviction) must not answer reads
+    from state it cannot vouch for — the client gets a NACK, not a value
+    from a possibly-wrong committed head."""
+    from indy_plenum_tpu.common.constants import (
+        GET_NYM,
+        TARGET_NYM,
+        TXN_TYPE,
+    )
+    from indy_plenum_tpu.common.request import Request
+
+    pool = NodePool(4, seed=7)
+    req = pool.make_nym_request()
+    pool.submit_to("node0", req)
+    pool.run_for(15)
+    assert all_ordered(pool, 1)
+
+    node = pool.node("node2")
+    read = Request(identifier=pool.trustee.identifier, reqId=999,
+                   operation={TXN_TYPE: GET_NYM,
+                              TARGET_NYM: req.operation["dest"]})
+    # healthy: the read is served
+    assert node.submit_client_request(read, client_id="c1") is True
+    assert isinstance(node.client_outbox[-1][1], Reply)
+
+    # catching up: the same read is refused
+    node.data.is_participating = False
+    read2 = Request(identifier=pool.trustee.identifier, reqId=1000,
+                    operation={TXN_TYPE: GET_NYM,
+                               TARGET_NYM: req.operation["dest"]})
+    assert node.submit_client_request(read2, client_id="c1") is False
+    nack = node.client_outbox[-1][1]
+    assert isinstance(nack, RequestNack) and "catching up" in nack.reason

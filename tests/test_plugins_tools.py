@@ -167,6 +167,7 @@ def test_provisioned_pool_orders_over_sockets(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+            n.client_surface.close()
         looper.shutdown()
         for s in stacks:
             s.close()

@@ -1,0 +1,334 @@
+"""Tier 3: catchup, membership change and key rotation over REAL sockets
+(VERDICT r3 item 2 — drag the socket tier up to what the sim proves).
+
+Reference capabilities: stp_zmq/kit_zstack.py (restart-on-key-change,
+registry-driven reconnection), plenum/test/node_catchup/ (lagging node
+rejoins), pool membership via NODE txns (plenum/server/pool_manager.py).
+"""
+import hashlib
+
+import pytest
+
+from indy_plenum_tpu.common.constants import (
+    ALIAS,
+    BLS_KEY,
+    BLS_KEY_PROOF,
+    DOMAIN_LEDGER_ID,
+    NODE,
+    NODE_IP,
+    NODE_PORT,
+    NYM,
+    ROLE,
+    SERVICES,
+    STEWARD,
+    TARGET_NYM,
+    TRANSPORT_VERKEY,
+    TXN_TYPE,
+    VALIDATOR,
+    VERKEY,
+)
+from indy_plenum_tpu.common.request import Request
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.crypto.signers import DidSigner
+from indy_plenum_tpu.network import ZStack, ZStackNetwork
+from indy_plenum_tpu.network.keys import curve_keypair_from_seed
+from indy_plenum_tpu.server.node import Node
+from indy_plenum_tpu.tools import generate_pool_config
+from indy_plenum_tpu.tools.local_pool import (
+    load_pool_info,
+    load_secret_seed,
+    run_pool,
+)
+
+FAST = {"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
+        "PropagateBatchWait": 0.02,
+        "ConsistencyProofsTimeout": 1.0,
+        "CatchupTransactionsTimeout": 1.5}
+
+
+def domain_size(node):
+    return node.boot.db.get_ledger(DOMAIN_LEDGER_ID).size
+
+
+def domain_root(node):
+    return node.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+
+
+def make_nym(trustee, tag, req_id, role=None):
+    target = DidSigner(hashlib.sha256(tag.encode()).digest())
+    op = {TXN_TYPE: NYM, TARGET_NYM: target.identifier,
+          VERKEY: target.verkey}
+    if role is not None:
+        op[ROLE] = role
+    req = Request(identifier=trustee.identifier, reqId=req_id, operation=op)
+    trustee.sign_request(req)
+    return req, target
+
+
+def order_and_wait(looper, nodes, trustee, tag, req_id, entry=0):
+    req, _ = make_nym(trustee, tag, req_id)
+    nodes[entry].submit_client_request(req, client_id="cli")
+    target_counts = {n.name: len(n.ordered_digests) + 1 for n in nodes}
+    ok = looper.run_until(
+        lambda: all(len(n.ordered_digests) >= target_counts[n.name]
+                    for n in nodes), timeout=30)
+    assert ok, [(n.name, len(n.ordered_digests)) for n in nodes]
+    return req
+
+
+@pytest.fixture()
+def socket_pool(tmp_path):
+    directory = str(tmp_path / "pool")
+    generate_pool_config(directory, n_nodes=4, base_port=17900,
+                         master_seed=b"\x31" * 32)
+    config = getConfig(dict(FAST))
+    looper, nodes, stacks = run_pool(directory, config=config)
+    trustee = DidSigner(load_secret_seed(directory, "trustee"))
+    probe = Request(identifier=trustee.identifier, reqId=0,
+                    operation={TXN_TYPE: NYM, TARGET_NYM: "warm"})
+    trustee.sign_request(probe)
+    nodes[0].authnr.authenticate_batch([probe])  # warm device kernel
+    yield directory, config, looper, nodes, stacks, trustee
+    looper.shutdown()
+    for node in nodes:
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 — test replaced/stopped instances
+            pass
+        surface = getattr(node, "client_surface", None)
+        if surface is not None:
+            try:
+                surface.close()
+            except Exception:  # noqa: BLE001
+                pass
+    for stack in stacks:
+        try:
+            stack.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def test_restarted_node_rejoins_via_catchup_over_sockets(socket_pool):
+    """A node that was down while the pool kept ordering rejoins through
+    the real-socket catchup plane (Seeder answers over ZMQ) and orders the
+    live tail again."""
+    directory, config, looper, nodes, stacks, trustee = socket_pool
+    order_and_wait(looper, nodes, trustee, "mem-a-0", 1)
+
+    behind, behind_stack = nodes[3], stacks[3]
+    looper.remove(behind_stack)  # the process freezes
+
+    live = nodes[:3]
+    for i in range(4):
+        req, _ = make_nym(trustee, f"mem-a-{i + 1}", i + 2)
+        live[0].submit_client_request(req, client_id="cli")
+    ok = looper.run_until(
+        lambda: all(len(n.ordered_digests) >= 5 for n in live), timeout=30)
+    assert ok, [len(n.ordered_digests) for n in live]
+    assert domain_size(behind) < domain_size(live[0])
+
+    looper.add(behind_stack)  # it comes back ...
+    behind.leecher.start()  # ... and boots into catchup (Node.start path)
+    ok = looper.run_until(
+        lambda: behind.leecher.catchups_completed >= 1
+        and domain_size(behind) == domain_size(live[0]), timeout=30)
+    assert ok, (domain_size(behind), domain_size(live[0]))
+    assert domain_root(behind) == domain_root(live[0])
+
+    # live again: it participates in NEW ordering
+    order_and_wait(looper, nodes, trustee, "mem-a-tail", 50)
+    assert domain_root(behind) == domain_root(live[0])
+
+
+def test_node_added_by_txn_joins_over_sockets(socket_pool):
+    """A NODE txn adds a 5th validator: the membership hook connects the
+    existing nodes' transports to it (KIT registry sync), quorums extend
+    to n=5, and the new node catches up + orders with the pool."""
+    directory, config, looper, nodes, stacks, trustee = socket_pool
+    info = load_pool_info(directory)
+    order_and_wait(looper, nodes, trustee, "mem-b-0", 1)
+
+    # provision node4's identities
+    node4_seed = hashlib.sha256(b"membership-node4-seed").digest()
+    node4_public, _ = curve_keypair_from_seed(node4_seed)
+    from indy_plenum_tpu.bls.factory import generate_bls_keys
+
+    kp4, bls_pk4, bls_pop4 = generate_bls_keys(
+        hashlib.sha256(b"membership-node4-bls").digest())
+
+    # its listener must exist before the pool learns its address
+    stack4 = ZStack("node4", node4_seed,
+                    max_batch=config.OUTGOING_BATCH_SIZE,
+                    msg_len_limit=config.MSG_LEN_LIMIT)
+    for peer, rec in info["nodes"].items():
+        key = rec["transport_public"].encode()
+        stack4.allow_peer(peer, key)
+        stack4.connect(peer, (rec["node_ip"], rec["node_port"]), key)
+
+    # steward onboarding: trustee writes the steward NYM (through
+    # consensus), then the steward adds its node
+    req_steward, steward4 = make_nym(trustee, "mem-b-steward4", 2,
+                                     role=STEWARD)
+    nodes[1].submit_client_request(req_steward, client_id="cli")
+    ok = looper.run_until(
+        lambda: all(n.get_nym_data(steward4.identifier) is not None
+                    for n in nodes), timeout=30)
+    assert ok
+
+    node_txn = Request(
+        identifier=steward4.identifier, reqId=1,
+        operation={TXN_TYPE: NODE, TARGET_NYM: "nym-node4",
+                   "data": {ALIAS: "node4",
+                            NODE_IP: stack4.ha[0],
+                            NODE_PORT: stack4.ha[1],
+                            SERVICES: [VALIDATOR],
+                            BLS_KEY: bls_pk4,
+                            BLS_KEY_PROOF: bls_pop4,
+                            TRANSPORT_VERKEY: node4_public.decode()}})
+    steward4.sign_request(node_txn)
+    nodes[2].submit_client_request(node_txn, client_id="cli")
+    ok = looper.run_until(
+        lambda: all(len(n.data.validators) == 5 for n in nodes), timeout=30)
+    assert ok, [n.data.validators for n in nodes]
+    # quorums extended and transports connected (KIT hook consumed it)
+    assert all(n.data.quorums.n == 5 for n in nodes)
+    assert all("node4" in s.connected_peers for s in stacks)
+
+    # boot the new validator: genesis view of the pool + catchup
+    net4 = ZStackNetwork(stack4)
+    from indy_plenum_tpu.ledger.genesis import load_genesis_file
+    import os
+
+    bls_keys = {peer: (None, rec["bls_key"], rec["bls_pop"])
+                for peer, rec in info["nodes"].items()}
+    bls_keys["node4"] = (kp4, bls_pk4, bls_pop4)
+    node4 = Node(
+        "node4", list(info["validators"]), looper.timer, net4,
+        config=config,
+        pool_genesis=load_genesis_file(
+            os.path.join(directory, "pool_genesis.jsonl")),
+        domain_genesis=load_genesis_file(
+            os.path.join(directory, "domain_genesis.jsonl")),
+        seed_keys={info["trustee_did"]: info["trustee_verkey"]},
+        bls_keys=bls_keys)
+    net4.mark_connected(set(info["validators"]))
+    node4.on_membership_changed_hook = net4.membership_hook
+    node4.start()
+    looper.add(stack4)
+    node4.leecher.start()
+    ok = looper.run_until(
+        lambda: node4.leecher.catchups_completed >= 1
+        and len(node4.data.validators) == 5, timeout=30)
+    assert ok, (node4.leecher.catchups_completed, node4.data.validators)
+    assert domain_root(node4) == domain_root(nodes[0])
+
+    # the 5-validator pool orders new traffic INCLUDING the new member
+    all_nodes = nodes + [node4]
+    order_and_wait(looper, all_nodes, trustee, "mem-b-tail", 60, entry=2)
+    assert domain_root(node4) == domain_root(nodes[0])
+
+    node4.stop()
+    stack4.close()
+
+
+def test_key_rotation_restarts_connections_over_sockets(socket_pool):
+    """A NODE txn rotating a member's transport key makes every peer
+    restart that connection under the new key (KIT restart-on-key-change);
+    the rotated node rejoins after its own restart and the OLD key is no
+    longer admitted anywhere."""
+    directory, config, looper, nodes, stacks, trustee = socket_pool
+    info = load_pool_info(directory)
+    order_and_wait(looper, nodes, trustee, "mem-c-0", 1)
+
+    victim, victim_stack = nodes[3], stacks[3]
+    old_key = victim_stack.public_key
+    port = info["nodes"]["node3"]["node_port"]
+
+    # operator takes node3 down for the rotation
+    looper.remove(victim_stack)
+    looper.remove(victim.client_surface)
+    victim.stop()
+    victim_stack.close()
+    victim.client_surface.close()
+
+    new_seed = hashlib.sha256(b"node3-rotated-seed").digest()
+    new_public, _ = curve_keypair_from_seed(new_seed)
+
+    # node3's steward commits the rotation (steward-3 owns nym-node3);
+    # steward seeds derive from the fixture's master seed
+    master = b"\x31" * 32
+    steward3 = DidSigner(hashlib.sha256(master + b"steward-3").digest())
+    rotate = Request(
+        identifier=steward3.identifier, reqId=1,
+        operation={TXN_TYPE: NODE, TARGET_NYM: "nym-node3",
+                   "data": {ALIAS: "node3",
+                            TRANSPORT_VERKEY: new_public.decode()}})
+    steward3.sign_request(rotate)
+    survivors = nodes[:3]
+    survivor_stacks = stacks[:3]
+    nodes[0].submit_client_request(rotate, client_id="cli")
+    ok = looper.run_until(
+        lambda: all(
+            s._allowed.get(new_public) == "node3" for s in survivor_stacks),
+        timeout=30)
+    assert ok
+    # the OLD key is gone from every allow-list: it cannot authenticate
+    for s in survivor_stacks:
+        assert old_key not in s._allowed
+
+    # node3 restarts under the new key on the same port and rejoins
+    new_stack = ZStack("node3", new_seed, bind_port=port,
+                       max_batch=config.OUTGOING_BATCH_SIZE,
+                       msg_len_limit=config.MSG_LEN_LIMIT)
+    for peer, rec in info["nodes"].items():
+        if peer == "node3":
+            continue
+        key = rec["transport_public"].encode()
+        new_stack.allow_peer(peer, key)
+        new_stack.connect(peer, (rec["node_ip"], rec["node_port"]), key)
+    net3 = ZStackNetwork(new_stack)
+    from indy_plenum_tpu.ledger.genesis import load_genesis_file
+    import os
+
+    from indy_plenum_tpu.bls.factory import generate_bls_keys
+
+    own_kp, _, _ = generate_bls_keys(
+        load_secret_seed(directory, "node3", key="bls_seed"))
+    bls_keys = {peer: (own_kp if peer == "node3" else None,
+                       rec["bls_key"], rec["bls_pop"])
+                for peer, rec in info["nodes"].items()}
+    node3 = Node(
+        "node3", list(info["validators"]), looper.timer, net3,
+        config=config,
+        pool_genesis=load_genesis_file(
+            os.path.join(directory, "pool_genesis.jsonl")),
+        domain_genesis=load_genesis_file(
+            os.path.join(directory, "domain_genesis.jsonl")),
+        seed_keys={info["trustee_did"]: info["trustee_verkey"]},
+        bls_keys=bls_keys)
+    net3.mark_connected(set(info["validators"]) - {"node3"})
+    node3.on_membership_changed_hook = net3.membership_hook
+    node3.start()
+    looper.add(new_stack)
+    node3.leecher.start()
+    ok = looper.run_until(
+        lambda: node3.leecher.catchups_completed >= 1
+        and domain_size(node3) == domain_size(nodes[0]), timeout=30)
+    assert ok
+    assert domain_root(node3) == domain_root(nodes[0])
+
+    # the rotated pool orders new traffic on all four members
+    all_nodes = survivors + [node3]
+    req, _ = make_nym(trustee, "mem-c-tail", 70)
+    survivors[0].submit_client_request(req, client_id="cli")
+    target = domain_size(nodes[0]) + 1
+    ok = looper.run_until(
+        lambda: all(domain_size(n) >= target for n in all_nodes),
+        timeout=30)
+    assert ok, [domain_size(n) for n in all_nodes]
+    assert domain_root(node3) == domain_root(nodes[0])
+
+    node3.stop()
+    new_stack.close()
+    nodes[3] = node3  # fixture teardown closes the new instance
+    stacks[3] = new_stack

@@ -102,3 +102,29 @@ def test_view_change_digest_stable():
     assert view_change_digest(v1) == view_change_digest(v2)
     v3 = vc(prepared=[(1, 0, 2, "x")])
     assert view_change_digest(v1) != view_change_digest(v3)
+
+
+def test_primary_fault_codes_derive_from_named_suspicions():
+    """Round-3 hardening: the primary-convicting set is built from the
+    named suspicion catalogue — renumbering suspicion_codes.py cannot
+    silently desync it from the trigger service."""
+    from indy_plenum_tpu.server.consensus.view_change_trigger_service import (
+        ViewChangeTriggerService,
+    )
+    from indy_plenum_tpu.server.suspicion_codes import Suspicions
+
+    codes = ViewChangeTriggerService.PRIMARY_FAULT_CODES
+    named = {
+        Suspicions.DUPLICATE_PPR_SENT,
+        Suspicions.PPR_DIGEST_WRONG,
+        Suspicions.PPR_STATE_WRONG,
+        Suspicions.PPR_TXN_WRONG,
+        Suspicions.PPR_TIME_WRONG,
+        Suspicions.PPR_BLS_MULTISIG_WRONG,
+        Suspicions.PPR_AUDIT_TXN_ROOT_WRONG,
+        Suspicions.PPR_DISCARDED_WRONG,
+    }
+    assert codes == {s.code for s in named}
+    # non-primary-specific evidence must NOT convict the primary
+    assert Suspicions.DUPLICATE_PR_SENT.code not in codes
+    assert Suspicions.CATCHUP_REP_WRONG.code not in codes

@@ -244,3 +244,34 @@ def test_primary_crash_detected_and_view_changed_over_sockets():
     looper.shutdown()
     for name in names[1:]:
         stacks[name].close()
+
+
+def test_hwm_drop_is_counted():
+    """Silent HWM drops are now observable: the stack counts messages
+    lost to a full peer queue (and reports them to metrics when wired)."""
+    import zmq
+
+    from indy_plenum_tpu.common.metrics_collector import (
+        MetricsCollector,
+        MetricsName,
+    )
+
+    stacks = wire(["A", "B"])
+    metrics = MetricsCollector()
+    stacks["B"]._metrics = metrics
+    real_sock = stacks["B"]._remotes["A"]
+
+    class FullSocket:
+        def send(self, *a, **k):
+            raise zmq.Again()
+
+    stacks["B"]._remotes["A"] = FullSocket()
+    for i in range(3):
+        stacks["B"].send(make_msg(i + 1), ["A"])
+    stacks["B"]._flush()
+    assert stacks["B"].dropped == 3
+    stat = metrics.stat(MetricsName.ZSTACK_DROPPED)
+    assert stat is not None and stat.total == 3
+    stacks["B"]._remotes["A"] = real_sock
+    for s in stacks.values():
+        s.close()

@@ -281,6 +281,7 @@ def bench_catchup_offload() -> dict:
     from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
     from indy_plenum_tpu.ledger.merkle_verifier import MerkleVerifier, STH
     from indy_plenum_tpu.server.catchup.catchup_rep_service import (
+        dispatch_audit_paths_batch,
         verify_audit_paths_batch,
     )
     from indy_plenum_tpu.simulation.pool import SimPool
@@ -328,22 +329,27 @@ def bench_catchup_offload() -> dict:
         for i in range(batch_size, batch_size + n_txns):
             pool.submit_request(i)
         pending = list(slices)
+        inflight = None  # the production pipeline: dispatch async, keep
+        # ordering, resolve on the next loop pass (CatchupRepService shape)
         done = 0
         t0 = time.perf_counter()
         target = batch_size + n_txns
         while (min(len(n.ordered_digests) for n in pool.nodes) < target
-               or pending) and time.monotonic() < deadline:
+               or pending or inflight) and time.monotonic() < deadline:
             pool.run_for(0.25)
+            if inflight is not None:
+                assert inflight().all()
+                inflight = None
+                done += 1
             if pending:
                 data, idxs, paths = pending.pop(0)
                 if device:
-                    ok = verify_audit_paths_batch(
+                    inflight = dispatch_audit_paths_batch(
                         data, idxs, paths, tree_size, root)
-                    assert ok.all()
                 else:
                     for d, i, p in zip(data, idxs, paths):
                         assert verifier.verify_leaf_inclusion(d, i, p, sth)
-                done += 1
+                    done += 1
         elapsed = time.perf_counter() - t0
         ordered = min(len(n.ordered_digests)
                       for n in pool.nodes) - batch_size

@@ -97,9 +97,7 @@ class Client:
         """Send a write to ``to`` (default: all validators — the client
         needs f+1 REPLYs, and up to f nodes may ignore it)."""
         targets = to if to is not None else list(self._validators)
-        state = self.pending[request.digest] = PendingRequest(
-            request, needed=self._f + 1)
-        self._by_idr[(request.identifier, request.reqId)] = state
+        state = self._track(request, needed=self._f + 1)
         for node in targets:
             self._send(request, node, self.name)
         return request.digest
@@ -112,17 +110,27 @@ class Client:
         unproved answer is never trusted."""
         if request.txn_type == GET_NYM:
             node = to or self._validators[0]
-            state = self.pending[request.digest] = PendingRequest(
-                request, needed=1)
-            self._by_idr[(request.identifier, request.reqId)] = state
+            self._track(request, needed=1)
             self._send(request, node, self.name)
         else:
-            state = self.pending[request.digest] = PendingRequest(
-                request, needed=self._f + 1)
-            self._by_idr[(request.identifier, request.reqId)] = state
+            self._track(request, needed=self._f + 1)
             for node in self._validators:
                 self._send(request, node, self.name)
         return request.digest
+
+    def _track(self, request: Request, needed: int) -> PendingRequest:
+        """Register a pending request. (identifier, reqId) must be unique
+        among in-flight requests — node replies carry only that pair, so
+        a duplicate would silently steal the earlier request's replies."""
+        key = (request.identifier, request.reqId)
+        if key in self._by_idr:
+            raise ValueError(
+                f"reqId {request.reqId} already pending for "
+                f"{request.identifier}; pick a fresh reqId")
+        state = self.pending[request.digest] = PendingRequest(
+            request, needed=needed)
+        self._by_idr[key] = state
+        return state
 
     # ------------------------------------------------------------------
 

@@ -48,13 +48,10 @@ class ClientZStack:
                  bind_port: int = 0,
                  msg_len_limit: int = 128 * 1024):
         self.name = name
-        # client-facing curve identity is derived SEPARATELY from the
-        # node-to-node key (different tag), so publishing it leaks nothing
-        # about the inter-validator plane
-        import hashlib
+        from .keys import client_stack_keypair_from_seed
 
-        self.public_key, self._secret_key = curve_keypair_from_seed(
-            hashlib.sha256(b"client-stack" + seed).digest())
+        self.public_key, self._secret_key = \
+            client_stack_keypair_from_seed(seed)
         self.on_request = on_request  # (Request, client_id) -> None
         self._msg_len_limit = msg_len_limit
 
@@ -70,6 +67,10 @@ class ClientZStack:
         self._listener.setsockopt(zmq.CURVE_SERVER, 1)
         self._listener.setsockopt(zmq.CURVE_SECRETKEY, self._secret_key)
         self._listener.setsockopt(zmq.LINGER, 0)
+        # unroutable replies must FAIL, not vanish: without MANDATORY a
+        # ROUTER silently discards sends to a departed identity and
+        # send_to_client's False path would be unreachable
+        self._listener.setsockopt(zmq.ROUTER_MANDATORY, 1)
         self._listener.bind(f"tcp://{bind_host}:{bind_port}")
         endpoint = self._listener.getsockopt_string(zmq.LAST_ENDPOINT)
         self.ha: Tuple[str, int] = (bind_host,

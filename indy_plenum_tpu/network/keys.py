@@ -15,6 +15,17 @@ import zmq
 import zmq.utils.z85 as z85
 
 
+def client_stack_keypair_from_seed(seed: bytes) -> Tuple[bytes, bytes]:
+    """The node's CLIENT-facing listener identity, derived separately from
+    its node-to-node key (publishing it must leak nothing about the
+    inter-validator plane). The single definition both the listener
+    (ClientZStack) and pool provisioning (generate_pool_config) use — two
+    copies of this derivation would silently desync the published
+    client_public from the key actually served."""
+    return curve_keypair_from_seed(
+        hashlib.sha256(b"client-stack" + seed).digest())
+
+
 def curve_keypair_from_seed(seed: bytes) -> Tuple[bytes, bytes]:
     """(public_z85, secret_z85) derived deterministically from ``seed``.
 

@@ -99,6 +99,12 @@ class ZStack:
         # is what lets the primary-disconnect detector work over sockets
         self._monitors: Dict[zmq.Socket, str] = {}
         self._peer_up: Dict[str, bool] = {}
+        # peers whose CURVE handshake ever completed on the current
+        # connection registration. NOT derivable from _peer_up: a
+        # ZAP-rejected attempt still emits EVENT_DISCONNECTED (TCP-level),
+        # so _peer_up can hold False entries for peers that never
+        # authenticated once
+        self._handshaken: set = set()
         self.on_connection_change = None  # (peer_name, up: bool) -> None
         # keep-in-touch (reference: stp_zmq/kit_zstack.py): periodically
         # RECREATE the DEALER of any peer whose curve handshake hasn't
@@ -176,6 +182,9 @@ class ZStack:
         self._remote_ha.pop(name, None)
         self.disallow_peer(name)
         self._peer_up.pop(name, None)
+        # a rotated/readmitted peer's fresh connection may be rejected
+        # again — the KIT retry must be willing to recreate it
+        self._handshaken.discard(name)
 
     def _retry_dead_connections(self) -> None:
         """KIT reconnect pass: any peer without a completed handshake gets
@@ -187,7 +196,11 @@ class ZStack:
             return
         self._last_reconnect_check = now
         for name in list(self._remotes):
-            if self._peer_up.get(name) is True:
+            if name in self._handshaken:
+                # handshake once succeeded: libzmq's native reconnect
+                # handles transient drops AND preserves the messages
+                # already queued in the pipe — recreating the socket here
+                # would close(0) them away
                 continue
             ha = self._remote_ha.get(name)
             key = next((k for k, p in self._allowed.items() if p == name),
@@ -353,6 +366,7 @@ class ZStack:
                 kind = evt["event"]
                 if kind == zmq.EVENT_HANDSHAKE_SUCCEEDED:
                     up = True
+                    self._handshaken.add(peer)
                 elif kind == zmq.EVENT_DISCONNECTED:
                     up = False
                 else:

@@ -51,26 +51,47 @@ def verify_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
                              root: bytes) -> np.ndarray:
     """Verify many RFC 6962 audit paths at once; returns (B,) bool.
 
+    Synchronous wrapper over :func:`dispatch_audit_paths_batch` — callers
+    that can overlap device compute with other work (the catchup pipeline)
+    should dispatch instead and resolve later.
+    """
+    return dispatch_audit_paths_batch(
+        leaf_data, indices, paths, tree_size, root)()
+
+
+def dispatch_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
+                               paths: List[List[bytes]], tree_size: int,
+                               root: bytes):
+    """Start verifying many audit paths; returns ``resolve() -> (B,) bool``.
+
     Host-side assembly + one jitted device call (bucketed padding keeps
-    the compile cache small). Falls back to the scalar host verifier for
-    tiny batches.
+    the compile cache small). The device call is ASYNCHRONOUS — XLA
+    dispatch returns a future — so the protocol thread keeps running
+    while the device grinds; forcing happens inside ``resolve()``. This
+    is what makes the device path a true offload rather than a blocking
+    substitute (BASELINE config 5's offload claim, measured by
+    bench.py's catchup_offload_ordered_txns_ratio). Tiny batches verify
+    synchronously on the host (the round-trip would dominate).
     """
     n = len(leaf_data)
     if n == 0:
-        return np.zeros(0, bool)
+        empty = np.zeros(0, bool)
+        return lambda: empty
     if n < DEVICE_MIN_BATCH:
         v = MerkleVerifier()
         sth = STH(tree_size=tree_size, sha256_root_hash=root)
-        return np.array([
+        host = np.array([
             v.verify_leaf_inclusion(d, i, p, sth)
             for d, i, p in zip(leaf_data, indices, paths)], bool)
+        return lambda: host
 
     from ...ledger.tree_hasher import TreeHasher
     from ...tpu.sha256 import verify_audit_paths_indexed
 
     hasher = TreeHasher()
     if any(len(p) > _MAX_DEPTH for p in paths):
-        return np.zeros(n, bool)
+        bad = np.zeros(n, bool)
+        return lambda: bad
     size = _bucket(n)
     # vectorized packing: one frombuffer over the concatenated path bytes +
     # a single fancy-index scatter (the per-node Python loop used to cost
@@ -108,9 +129,9 @@ def verify_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
     ts = np.full(size, tree_size, np.int32)
     root_arr = np.ascontiguousarray(np.broadcast_to(
         np.frombuffer(root, np.uint8), (size, 32)))
-    ok = np.asarray(verify_audit_paths_indexed(
-        leaf, idx, table, path_idx, path_len, ts, root_arr))
-    return ok[:n]
+    ok_future = verify_audit_paths_indexed(
+        leaf, idx, table, path_idx, path_len, ts, root_arr)
+    return lambda: np.asarray(ok_future)[:n]
 
 
 class CatchupRepService:
@@ -141,6 +162,11 @@ class CatchupRepService:
         self._outstanding: Dict[int, Tuple[int, str]] = {}
         # verified-but-early reps: start seq -> ordered txns
         self._ready: Dict[int, List[dict]] = {}
+        # ONE in-flight async device verification (sender, start, end,
+        # seqs, txns, resolve): dispatched on rep receipt, resolved when
+        # the next rep arrives or the retry timer fires — device compute
+        # overlaps network wait + host packing of the next slice
+        self._inflight: Optional[tuple] = None
         self._peer_rr: List[str] = []
         self._retry = RepeatingTimer(
             timer, self._config.CatchupTransactionsTimeout,
@@ -175,6 +201,7 @@ class CatchupRepService:
 
     def stop(self) -> None:
         self._running = False
+        self._inflight = None
         self._retry.stop()
 
     def _send_requests(self, frm: int, to: int) -> None:
@@ -193,6 +220,7 @@ class CatchupRepService:
 
     def _rerequest_outstanding(self) -> None:
         """Reassign every still-unanswered slice to the next peer."""
+        self._resolve_inflight()
         if not self._running or not self._outstanding:
             return
         self._peer_rr = sorted(self._network.connecteds)
@@ -243,15 +271,38 @@ class CatchupRepService:
             self._bad_rep(sender, start)
             return
 
-        ok = verify_audit_paths_batch(
+        # pipeline: resolve the PREVIOUS slice's device verdict (its
+        # compute overlapped this rep's network+packing time), then
+        # dispatch this slice asynchronously
+        self._resolve_inflight()
+        if not self._running:
+            return  # resolution completed the ledger
+        if self._outstanding.get(start) != (end, sender):
+            return  # resolution re-assigned or satisfied this slice
+        resolve = dispatch_audit_paths_batch(
             leaf_data, indices, paths, self._target_size, self._target_root)
+        self._inflight = (sender, start, end, seqs, txns, resolve)
+        # backstop: if no further rep arrives to trigger resolution (the
+        # final slice), resolve shortly — by then the device is done or
+        # nearly so
+        self._timer.schedule(0.05, self._resolve_inflight)
+
+    def _resolve_inflight(self) -> None:
+        if self._inflight is None or not self._running:
+            self._inflight = None
+            return
+        sender, start, end, seqs, txns, resolve = self._inflight
+        self._inflight = None
+        expected = self._outstanding.get(start)
+        if expected is None or expected != (end, sender):
+            return  # superseded while in flight (reassigned / satisfied)
+        ok = resolve()
         if not ok.all():
             logger.warning(
                 "catchup ledger %d: %d/%d txns from %s FAIL audit proof",
                 self._ledger_id, int((~ok).sum()), len(ok), sender)
             self._bad_rep(sender, start)
             return
-
         del self._outstanding[start]
         self._ready[start] = [txns[str(s)] for s in seqs]
         if seqs[-1] < end:

@@ -428,6 +428,15 @@ class Node:
             result.update(identifier=req.identifier, reqId=req.reqId)
             self._to_client(client_id, Reply(result=result))
             return True
+        # replay check FIRST: a client retrying an already-committed write
+        # (lost REPLY) must learn its fate even while writes are disabled
+        seen = self.req_idr_to_txn.get_by_payload_digest(req.payload_digest)
+        if seen is not None:
+            lid, seq = seen
+            self._to_client(client_id, RequestNack(
+                identifier=req.identifier, reqId=req.reqId,
+                reason=f"already processed: ledger {lid} seqNo {seq}"))
+            return False
         # pool-wide write switch (config ledger, POOL_CONFIG): when a
         # trustee disabled writes, every node NACKs write ingress — except
         # POOL_CONFIG itself, or the pool could never be re-enabled
@@ -438,13 +447,6 @@ class Node:
             self._to_client(client_id, RequestNack(
                 identifier=req.identifier, reqId=req.reqId,
                 reason="pool writes are disabled (POOL_CONFIG)"))
-            return False
-        seen = self.req_idr_to_txn.get_by_payload_digest(req.payload_digest)
-        if seen is not None:
-            lid, seq = seen
-            self._to_client(client_id, RequestNack(
-                identifier=req.identifier, reqId=req.reqId,
-                reason=f"already processed: ledger {lid} seqNo {seq}"))
             return False
         if client_id is not None:
             self._req_clients[req.digest] = client_id

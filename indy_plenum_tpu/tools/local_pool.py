@@ -66,9 +66,11 @@ def generate_pool_config(directory: str, n_nodes: int = 4,
         steward = DidSigner(derive(f"steward-{i}"))
         node_seed = derive(f"node-{i}")
         public, _secret = curve_keypair_from_seed(node_seed)
-        # the client listener's curve identity (ClientZStack derivation)
-        client_public, _ = curve_keypair_from_seed(
-            hashlib.sha256(b"client-stack" + node_seed).digest())
+        # the client listener's curve identity (shared derivation with
+        # ClientZStack — see network/keys.py)
+        from ..network.keys import client_stack_keypair_from_seed
+
+        client_public, _ = client_stack_keypair_from_seed(node_seed)
         # BLS signing identity: public key + proof of possession go into
         # the pool genesis NODE txn (reference: init_bls_keys)
         from ..bls.factory import generate_bls_keys

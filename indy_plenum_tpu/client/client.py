@@ -97,7 +97,7 @@ class Client:
         """Send a write to ``to`` (default: all validators — the client
         needs f+1 REPLYs, and up to f nodes may ignore it)."""
         targets = to if to is not None else list(self._validators)
-        state = self._track(request, needed=self._f + 1)
+        self._track(request, needed=self._f + 1)
         for node in targets:
             self._send(request, node, self.name)
         return request.digest
@@ -121,12 +121,17 @@ class Client:
     def _track(self, request: Request, needed: int) -> PendingRequest:
         """Register a pending request. (identifier, reqId) must be unique
         among in-flight requests — node replies carry only that pair, so
-        a duplicate would silently steal the earlier request's replies."""
+        a DIFFERENT request under a known pair would silently steal the
+        earlier one's replies. Resubmitting the SAME request (retry after
+        a lost REPLY) reuses its existing state and goes out again."""
         key = (request.identifier, request.reqId)
-        if key in self._by_idr:
+        existing = self._by_idr.get(key)
+        if existing is not None:
+            if existing.request.digest == request.digest:
+                return existing  # retry: resend, keep collected replies
             raise ValueError(
-                f"reqId {request.reqId} already pending for "
-                f"{request.identifier}; pick a fresh reqId")
+                f"reqId {request.reqId} already used by a different "
+                f"request for {request.identifier}; pick a fresh reqId")
         state = self.pending[request.digest] = PendingRequest(
             request, needed=needed)
         self._by_idr[key] = state

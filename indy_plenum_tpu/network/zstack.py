@@ -105,6 +105,7 @@ class ZStack:
         # so _peer_up can hold False entries for peers that never
         # authenticated once
         self._handshaken: set = set()
+        self._down_since: Dict[str, float] = {}  # peer -> monotonic time
         self.on_connection_change = None  # (peer_name, up: bool) -> None
         # keep-in-touch (reference: stp_zmq/kit_zstack.py): periodically
         # RECREATE the DEALER of any peer whose curve handshake hasn't
@@ -185,6 +186,7 @@ class ZStack:
         # a rotated/readmitted peer's fresh connection may be rejected
         # again — the KIT retry must be willing to recreate it
         self._handshaken.discard(name)
+        self._down_since.pop(name, None)
 
     def _retry_dead_connections(self) -> None:
         """KIT reconnect pass: any peer without a completed handshake gets
@@ -195,13 +197,21 @@ class ZStack:
         if now - self._last_reconnect_check < self._reconnect_interval:
             return
         self._last_reconnect_check = now
+        grace = 3 * self._reconnect_interval
         for name in list(self._remotes):
             if name in self._handshaken:
                 # handshake once succeeded: libzmq's native reconnect
                 # handles transient drops AND preserves the messages
-                # already queued in the pipe — recreating the socket here
-                # would close(0) them away
-                continue
+                # already queued in the pipe — recreating the socket would
+                # close(0) them away. But only within a grace window: a
+                # peer that restarted into a state that ZAP-rejects us is
+                # terminal for this socket, so after a prolonged outage a
+                # fresh DEALER is the only way back (queued messages are
+                # stale by then; MessageReq recovers protocol state).
+                down = self._down_since.get(name)
+                if down is None or now - down < grace:
+                    continue
+                self._handshaken.discard(name)
             ha = self._remote_ha.get(name)
             key = next((k for k, p in self._allowed.items() if p == name),
                        None)
@@ -367,8 +377,10 @@ class ZStack:
                 if kind == zmq.EVENT_HANDSHAKE_SUCCEEDED:
                     up = True
                     self._handshaken.add(peer)
+                    self._down_since.pop(peer, None)
                 elif kind == zmq.EVENT_DISCONNECTED:
                     up = False
+                    self._down_since.setdefault(peer, time.monotonic())
                 else:
                     continue
                 if self._peer_up.get(peer) != up:

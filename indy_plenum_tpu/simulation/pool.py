@@ -332,7 +332,8 @@ class SimPool:
                  sign_requests: bool = False,
                  bls: bool = False,
                  shadow_check: Optional[bool] = None,
-                 num_instances: int = 1):
+                 num_instances: int = 1,
+                 mesh=None):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -388,7 +389,7 @@ class SimPool:
         if device_quorum:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
-                num_instances=num_instances)
+                num_instances=num_instances, mesh=mesh)
 
         k = num_instances
         self.nodes: List[SimNode] = [

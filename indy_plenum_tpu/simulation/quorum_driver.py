@@ -15,17 +15,19 @@ from ..config import Config
 
 
 def make_vote_group(n_nodes: int, validators, config: Config,
-                    num_instances: int = 1):
+                    num_instances: int = 1, mesh=None):
     """Member axis = (node x instance): member i*num_instances + inst_id
     is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
     mapping — instances are a leading tensor dimension, so backups' vote
-    tallies ride the same vmapped dispatch as the master's)."""
+    tallies ride the same vmapped dispatch as the master's). ``mesh``
+    shards that member axis across a device mesh (SPMD group step)."""
     from ..tpu.vote_plane import VotePlaneGroup
 
     return VotePlaneGroup(
         n_nodes * max(1, num_instances), list(validators),
         log_size=config.LOG_SIZE,
-        n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
+        n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
+        mesh=mesh)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,

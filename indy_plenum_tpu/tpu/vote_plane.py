@@ -291,13 +291,36 @@ class VotePlaneGroup:
     """
 
     def __init__(self, n_members: int, validators: List[str], log_size: int,
-                 n_checkpoints: int = 4, h: int = 0, metrics=None):
+                 n_checkpoints: int = 4, h: int = 0, metrics=None,
+                 mesh=None):
+        """``mesh``: an optional :class:`jax.sharding.Mesh` with one axis;
+        the member axis of every vote tensor is sharded across it, so one
+        pod's chips split the pool's planes and the vmapped group step
+        runs SPMD (members are independent — no cross-member collectives
+        are needed; XLA keeps each chip's shard local). ``n_members`` must
+        divide evenly across the mesh."""
         self._n = len(validators)
         self._log_size = log_size
         self._n_chk = n_checkpoints
         proto = q.init_state(self._n, log_size, n_checkpoints)
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = mesh.axis_names[0]
+            if n_members % mesh.devices.size != 0:
+                raise ValueError(
+                    f"n_members={n_members} must divide the "
+                    f"{mesh.devices.size}-device mesh")
+            # member axis sharded; everything below it stays local
+            self._sharding = lambda ndim: NamedSharding(
+                mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
         self._states = jax.tree.map(
             lambda x: jnp.zeros((n_members,) + x.shape, x.dtype), proto)
+        if self._sharding is not None:
+            self._states = jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding(x.ndim)),
+                self._states)
         self._members = [
             _MemberPlane(self, i, validators, log_size, n_checkpoints, h)
             for i in range(n_members)]
@@ -315,6 +338,15 @@ class VotePlaneGroup:
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
 
+    def _place(self, msgs: q.MsgBatch) -> q.MsgBatch:
+        """Shard the (M, B) message batch like the states, so the group
+        step stays SPMD end-to-end (an unsharded operand would force an
+        all-gather + resharding every flush)."""
+        if self._sharding is None:
+            return msgs
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding(x.ndim)), msgs)
+
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
         if (not any(m._pending for m in self._members)
@@ -330,7 +362,7 @@ class VotePlaneGroup:
                                         m._pending[FLUSH_BATCH:])
                     chunks.append(take)
                     votes += len(take)
-                msgs = _pack_group_messages(chunks, FLUSH_BATCH)
+                msgs = self._place(_pack_group_messages(chunks, FLUSH_BATCH))
                 self._states, events = _group_step(
                     self._states, msgs, self._n)
                 self.flushes += 1
@@ -339,8 +371,8 @@ class VotePlaneGroup:
                                        votes)
                 stepped = True
             if not stepped:  # cold start: no votes recorded anywhere yet
-                msgs = _pack_group_messages(
-                    [[] for _ in self._members], FLUSH_BATCH)
+                msgs = self._place(_pack_group_messages(
+                    [[] for _ in self._members], FLUSH_BATCH))
                 self._states, events = _group_step(
                     self._states, msgs, self._n)
                 self.flushes += 1
@@ -357,7 +389,10 @@ class VotePlaneGroup:
         self.flush()
         deltas = np.zeros(len(self._members), np.int32)
         deltas[member_idx] = delta
-        self._states = _group_slide(self._states, jnp.asarray(deltas))
+        deltas = jnp.asarray(deltas)
+        if self._sharding is not None:
+            deltas = jax.device_put(deltas, self._sharding(1))
+        self._states = _group_slide(self._states, deltas)
         self.version += 1
         self._host_prepared = None
 

@@ -37,3 +37,55 @@ def test_dryrun_multichip_self_provisions_subprocess():
     # virtual host platform rather than assert.
     n = len(jax.devices()) * 2
     graft.dryrun_multichip(n)
+
+
+def test_sim_pool_orders_with_sharded_vote_group(eight_devices):
+    """VERDICT r3 item 8: consensus runs with the group vote tensors
+    actually SHARDED across the 8-device mesh (member axis split, SPMD
+    group step) and produces bit-identical ordering to the single-device
+    run — sharding is a placement choice, never a semantics change."""
+    import jax
+    from jax.sharding import Mesh
+
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.simulation.pool import SimPool
+
+    def run(mesh):
+        cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                         "QuorumTickInterval": 0.05})
+        pool = SimPool(8, seed=31, config=cfg, device_quorum=True,
+                       shadow_check=False, mesh=mesh)
+        for i in range(10):
+            pool.submit_request(i)
+        pool.run_for(30)
+        assert all(len(n.ordered_digests) == 10 for n in pool.nodes), \
+            [len(n.ordered_digests) for n in pool.nodes]
+        assert pool.honest_nodes_agree()
+        assert pool.vote_group.flushes > 0
+        return [tuple(n.ordered_digests) for n in pool.nodes]
+
+    mesh = Mesh(jax.devices()[:8], ("members",))
+    sharded_logs = run(mesh)
+    # the sharded states really live split across the mesh
+    single_logs = run(None)
+    assert sharded_logs == single_logs
+
+
+def test_sharded_vote_group_state_is_split_across_mesh(eight_devices):
+    """Placement proof: each chip holds exactly its member shard."""
+    import jax
+    from jax.sharding import Mesh
+
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.simulation.quorum_driver import make_vote_group
+
+    mesh = Mesh(jax.devices()[:8], ("members",))
+    cfg = getConfig({"LOG_SIZE": 8, "CHK_FREQ": 4})
+    group = make_vote_group(8, [f"n{i}" for i in range(8)], cfg, mesh=mesh)
+    group.view(0).record_prepare("n1", 1)
+    group.flush()
+    votes = group._states.prepare_votes  # (8 members, 8 validators, 8 slots)
+    assert len(votes.sharding.device_set) == 8
+    # one member per device: the addressable shard is (1, 8, 8)
+    shard = votes.addressable_shards[0]
+    assert shard.data.shape[0] == votes.shape[0] // 8

@@ -208,6 +208,13 @@ class LedgerStatus(MessageBase):
         ("ppSeqNo", NonNegativeNumberField(nullable=True)),
         ("merkleRoot", MerkleRootField()),
         ("protocolVersion", ProtocolVersionField()),
+        # True marks a fork-point PROBE: "what is your root at this
+        # size?" — a question, not an assertion about the sender's own
+        # ledger. Receivers must answer probes (SeederService) but never
+        # count them as status evidence (divergence/tip votes), or a
+        # diverged prober's corrupt prefix root would masquerade as a
+        # genuine accusation against healthy nodes.
+        ("probe", BooleanField(optional=True)),
     )
 
 

@@ -103,6 +103,12 @@ class Config:
     METRICS_COLLECTOR_TYPE: Optional[str] = "kv"
     METRICS_FLUSH_INTERVAL: float = 10.0
     RECORDER_ENABLED: bool = False
+    # logging (reference: stp logging config + rotating handler)
+    logLevel: str = "INFO"
+    logRotationMaxBytes: int = 10 * 1024 * 1024
+    logRotationBackupCount: int = 10
+    logRotationWhen: str = "h"
+    logRotationInterval: int = 1
 
     # --- plugins ----------------------------------------------------------
     # importable module paths, each exposing plugin_entry(node)

@@ -105,11 +105,28 @@ class ConsProofService:
 
     def process_ledger_status(self, status: LedgerStatus, sender: str):
         """A peer's own status: votes 'you are caught up' when it matches
-        us; a same-size DIFFERENT root is a divergence vote."""
+        us; a same-size DIFFERENT root is a divergence vote. A BEHIND
+        peer's status is evidence too — if our prefix at their size
+        matches their root, they vote for a target at their tip (we are
+        AHEAD of the pool: uncommitted/corrupt tail to truncate); if our
+        prefix differs, that is a divergence vote."""
         if not self._running or status.ledgerId != self._ledger_id:
             return
-        if status.txnSeqNo != self._own_size:
+        if getattr(status, "probe", None):
+            return  # a fork-search QUESTION, not an assertion — no vote
+        if status.txnSeqNo > self._own_size:
             return  # ahead peers vote via CONSISTENCY_PROOF instead
+        if status.txnSeqNo < self._own_size:
+            ledger = self._db.get_ledger(self._ledger_id)
+            # root_hash_at(0) is the RFC 6962 empty-tree hash — the same
+            # value an empty peer's status carries (no "" sentinel, which
+            # would convict healthy nodes against fresh peers)
+            ours_at = b58encode(ledger.root_hash_at(status.txnSeqNo))
+            if status.merkleRoot == ours_at:
+                self._add_vote((status.txnSeqNo, status.merkleRoot), sender)
+            else:
+                self._add_divergence_vote(sender)
+            return
         if status.merkleRoot == self._own_root_b58:
             self._add_vote((self._own_size, self._own_root_b58), sender)
         else:

@@ -96,6 +96,16 @@ class NodeLeecherService:
                 lid, network, timer, self._boot.db, config=self._config,
                 suspicion_sink=self._suspicion)
             for lid in (AUDIT_LEDGER_ID,) + LEDGER_ORDER}
+        # divergence recovery: find the fork point and refetch a SUFFIX
+        # instead of nuking the whole ledger (r3 verdict weakness 7)
+        from .fork_point_service import ForkPointService
+
+        self._fork_services = {
+            lid: ForkPointService(
+                lid, network, timer, self._boot.db,
+                quorums_provider=lambda: self._data.quorums,
+                config=self._config)
+            for lid in (AUDIT_LEDGER_ID,) + LEDGER_ORDER}
 
         bus.subscribe(NeedMasterCatchup, self._on_need_catchup)
 
@@ -140,13 +150,28 @@ class NodeLeecherService:
     def _on_audit_target(self, target, diverged: bool) -> None:
         audit = self._boot.db.get_ledger(AUDIT_LEDGER_ID)
         if diverged:
-            logger.warning("%s: audit ledger diverged; resyncing from "
-                           "scratch", self._data.name)
-            audit.reset_to(0)
-            self._restart_audit_phase()
+            logger.warning("%s: audit ledger diverged; searching for the "
+                           "fork point", self._data.name)
+
+            def on_fork(fork: int) -> None:
+                audit.reset_to(fork)
+                self._restart_audit_phase()
+
+            self._fork_services[AUDIT_LEDGER_ID].start(on_fork)
             return
         size, root_b58 = target
         self._audit_target = (size, b58decode(root_b58))
+        if size < audit.size:
+            # the quorum target sits BELOW us: we are ahead of the pool
+            # (crash before peers committed, or a corrupt tail). If our
+            # prefix at the target matches, truncate to it — the txns
+            # either re-order identically or were never honest; keeping a
+            # tail no quorum vouches for would fail the fetch check anyway
+            if size > 0 and audit.root_hash_at(size) \
+                    == self._audit_target[1]:
+                audit.reset_to(size)
+            else:
+                audit.reset_to(0)  # ahead AND diverged below the target
         self._rep_services[AUDIT_LEDGER_ID].start(
             size, self._audit_target[1], self._on_audit_fetched)
 
@@ -201,9 +226,19 @@ class NodeLeecherService:
             if ledger.size > size or (
                     ledger.size == size and ledger.root_hash != root):
                 logger.warning("%s: ledger %d diverged from audit target; "
-                               "resyncing from scratch",
+                               "searching for the fork point",
                                self._data.name, lid)
-                ledger.reset_to(0)
+
+                def on_fork(fork: int, lid=lid, size=size) -> None:
+                    # never keep more than the target prefix: beyond it we
+                    # cannot cross-check against the audit-pinned root
+                    self._boot.db.get_ledger(lid).reset_to(
+                        min(fork, size))
+                    self._remaining.insert(0, lid)
+                    self._next_ledger()
+
+                self._fork_services[lid].start(on_fork)
+                return
             if ledger.size == size:
                 continue
             self._current_lid = lid

@@ -69,7 +69,13 @@ class SeederService:
             return
         their_size = status.txnSeqNo
         if their_size > ledger.size:
-            return  # we are the laggard; our own leecher handles that
+            # the peer claims to be AHEAD of us: echo our own status. An
+            # ahead-but-diverged peer (corrupt extra tail) gets no
+            # consistency proofs from anyone — without this echo it could
+            # never learn the pool's tip and would spin in catchup forever
+            self._network.send(self.own_ledger_status(status.ledgerId),
+                               [sender])
+            return
         if their_size == ledger.size:
             # equality vote (also lets a diverged same-size peer notice the
             # root mismatch in our status)
@@ -82,8 +88,10 @@ class SeederService:
             seqNoEnd=ledger.size,
             viewNo=None,
             ppSeqNo=None,
-            oldMerkleRoot=b58encode(ledger.root_hash_at(their_size))
-            if their_size > 0 else b58encode(b"\x00" * 32),
+            # root_hash_at(0) is the RFC 6962 empty-tree hash — one
+            # convention everywhere (a zero-byte sentinel here would
+            # desync from the statuses empty peers genuinely send)
+            oldMerkleRoot=b58encode(ledger.root_hash_at(their_size)),
             newMerkleRoot=b58encode(ledger.root_hash),
             hashes=[b58encode(h)
                     for h in ledger.consistency_proof(their_size)],

@@ -349,6 +349,12 @@ class Node:
                 active=False)
         self.vote_plane = vote_plane
 
+        # --- notifier: operator events -> pluggable sinks ----------------
+        from .notifier import NotifierService
+
+        self.notifier = NotifierService(name, self.internal_bus,
+                                        timer=timer)
+
         # --- plugins (LAST: entries get a fully constructed node) -------
         from ..plugins import load_plugins
 
@@ -516,6 +522,7 @@ class Node:
             "ledger_sizes": ledgers,
             "num_instances": self.num_instances,
             "metrics": self.metrics.summary(),
+            "recent_events": list(self.notifier.events)[-20:],
         }
 
     def _enqueue_for_auth(self, req: Request) -> None:

@@ -21,6 +21,17 @@ def main() -> int:
         print(__doc__)
         return 2
     directory, name = sys.argv[1], sys.argv[2]
+    from indy_plenum_tpu.common.log import setup_logging
+    from indy_plenum_tpu.config import getConfig
+
+    config = getConfig()
+    setup_logging(
+        level=config.logLevel,
+        log_file=os.path.join(directory, "logs", f"{name}.log"),
+        max_bytes=config.logRotationMaxBytes,
+        backup_count=config.logRotationBackupCount,
+        when=config.logRotationWhen,
+        interval=config.logRotationInterval)
     looper = Looper()
     node, stack = build_node(directory, name, looper)
     node.start()

@@ -319,3 +319,94 @@ def test_ledger_reset_to():
     assert ledger.size == 10
     with pytest.raises(KeyError):
         Ledger().get_by_seq_no(1)
+
+
+def test_diverged_node_refetches_only_the_suffix():
+    """r3 verdict weakness 7: divergence recovery finds the fork point
+    (binary search over peer root-at-size probes) and re-downloads only
+    the txns past it — not the whole ledger."""
+    from indy_plenum_tpu.common.messages.node_messages import CatchupReq
+
+    pool = make_pool(seed=26)
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(12)
+    assert len(set(domain_roots(pool))) == 1
+
+    evil = pool.node("node1")
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    good_domain, good_audit = domain.size, audit.size
+    # corrupt ONLY the tail: the last 2 txns of each ledger
+    domain.reset_to(good_domain - 2)
+    domain.add({"fake": 1})
+    domain.add({"fake": 2})
+    audit.reset_to(good_audit - 2)
+    audit.add({"fake_audit": 1})
+    audit.add({"fake_audit": 2})
+
+    reqs = []
+
+    def record(msg, frm, to):
+        if isinstance(msg, CatchupReq) and frm == "node1":
+            reqs.append(msg)
+        return None
+
+    pool.network.add_delayer(record)
+    evil.leecher.start()
+    pool.run_for(30)
+
+    assert evil.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash == \
+        pool.node("node0").boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+    assert evil.boot.db.get_ledger(AUDIT_LEDGER_ID).root_hash == \
+        pool.node("node0").boot.db.get_ledger(AUDIT_LEDGER_ID).root_hash
+    # the fork search kept the honest prefix: every fetch started past it
+    assert reqs, "no catchup requests recorded"
+    audit_reqs = [r for r in reqs if r.ledgerId == AUDIT_LEDGER_ID]
+    domain_reqs = [r for r in reqs if r.ledgerId == DOMAIN_LEDGER_ID]
+    assert audit_reqs and min(r.seqNoStart for r in audit_reqs) \
+        >= good_audit - 1
+    assert domain_reqs and min(r.seqNoStart for r in domain_reqs) \
+        >= good_domain - 1
+    # and the pool keeps agreeing on new traffic afterwards
+    for i in range(100, 103):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert len(set(domain_roots(pool))) == 1
+    assert len(set(domain_sizes(pool))) == 1
+
+
+def test_node_ahead_of_pool_with_corrupt_tail_recovers():
+    """A node whose ledger is LONGER than every honest peer's (corrupt
+    extra tail) used to get zero catchup responses — peers ignored
+    ahead-peer statuses — and spun forever. Now behind-peers echo their
+    tips, the cons-proof/fork-point planes treat those as evidence, and
+    the node truncates to the pool's honest tip."""
+    pool = make_pool(seed=27)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+    assert len(set(domain_roots(pool))) == 1
+
+    evil = pool.node("node2")
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    honest_domain = domain.size
+    # extra FAKE txns beyond the pool's tip on both ledgers
+    domain.add({"fake": 1})
+    domain.add({"fake": 2})
+    audit.add({"fake_audit": 1})
+    assert domain.size == honest_domain + 2
+
+    evil.leecher.start()
+    pool.run_for(20)
+
+    assert len(set(domain_sizes(pool))) == 1, domain_sizes(pool)
+    assert len(set(domain_roots(pool))) == 1
+    assert evil.data.is_participating is True
+    # live again afterwards
+    for i in range(300, 303):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert len(set(domain_roots(pool))) == 1
+    assert len(set(domain_sizes(pool))) == 1

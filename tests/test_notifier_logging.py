@@ -1,0 +1,132 @@
+"""Notifier sinks + logging subsystem (VERDICT r3 missing items 5 and 7).
+
+Reference: plenum/server/notifier_plugin_manager.py (monitor events to
+pluggable sinks), stp_core/common/log.py + the
+TimeAndSizeRotatingFileHandler (bounded on-disk logs).
+"""
+import logging
+import os
+
+from indy_plenum_tpu.common.log import (
+    TimeAndSizeRotatingFileHandler,
+    getlogger,
+    setup_logging,
+)
+from indy_plenum_tpu.common.messages.node_messages import PrePrepare
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.server.notifier import (
+    CATCHUP_FAILED,
+    MASTER_DEGRADED,
+    VIEW_CHANGE_COMPLETE,
+    VIEW_CHANGE_STARTED,
+)
+from indy_plenum_tpu.simulation.node_pool import NodePool
+
+
+def test_degradation_and_view_change_reach_sinks():
+    """The throttled-master scenario end to end: the monitor's
+    degradation vote and the resulting view-change lifecycle land in
+    every node's registered sink (the reference's notifier plugin
+    surface), not just in logs."""
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+        "PropagateBatchWait": 0.05,
+        "ThroughputWindowSize": 2, "ThroughputMinCnt": 4,
+        "PerfCheckFreq": 2.0, "DELTA": 0.4,
+        "ToleratePrimaryDisconnection": 10_000.0,
+        "NewViewTimeout": 10_000.0,
+    })
+    pool = NodePool(4, seed=71, config=config, num_instances=0)
+    sink_events = {n.name: [] for n in pool.nodes}
+    for n in pool.nodes:
+        n.notifier.register_sink(
+            lambda e, name=n.name: sink_events[name].append(e))
+
+    master_primary = pool.nodes[0].data.primaries[0]
+
+    def throttle(msg, frm, to):
+        if isinstance(msg, PrePrepare) and frm == master_primary \
+                and msg.instId == 0:
+            return 60.0
+        return None
+
+    pool.network.add_delayer(throttle)
+    for i in range(16):
+        pool.submit_to("node1", pool.make_nym_request())
+    pool.run_for(60)
+
+    assert all(n.data.view_no >= 1 for n in pool.nodes)
+    for n in pool.nodes:
+        kinds = [e["kind"] for e in sink_events[n.name]]
+        assert VIEW_CHANGE_STARTED in kinds, (n.name, kinds)
+        assert VIEW_CHANGE_COMPLETE in kinds, (n.name, kinds)
+    # at least the degraded-detecting nodes emitted the monitor event
+    assert any(MASTER_DEGRADED in [e["kind"] for e in evs]
+               for evs in sink_events.values())
+    # the events also appear in VALIDATOR_INFO's snapshot
+    status = pool.nodes[1].node_status()
+    assert any(e["kind"] == VIEW_CHANGE_COMPLETE
+               for e in status["recent_events"])
+
+
+def test_catchup_failed_alarm_reaches_sink():
+    """The fail-closed alarm is an operator event (tier-1 severity)."""
+    from indy_plenum_tpu.common.messages.internal_messages import (
+        RaisedSuspicion,
+    )
+    from indy_plenum_tpu.common.exceptions import SuspiciousNode
+    from indy_plenum_tpu.server.suspicion_codes import Suspicions
+
+    pool = NodePool(4, seed=72)
+    node = pool.nodes[0]
+    got = []
+    node.notifier.register_sink(got.append)
+    node.internal_bus.send(RaisedSuspicion(inst_id=0, ex=SuspiciousNode(
+        node.name, Suspicions.CATCHUP_FAILED)))
+    assert any(e["kind"] == CATCHUP_FAILED for e in got)
+
+
+def test_raising_sink_is_isolated():
+    pool = NodePool(4, seed=73)
+    node = pool.nodes[0]
+    good = []
+
+    def bad_sink(event):
+        raise RuntimeError("webhook down")
+
+    node.notifier.register_sink(bad_sink)
+    node.notifier.register_sink(good.append)
+    node.notifier._emit("test_event", detail=1)
+    assert good and good[0]["kind"] == "test_event"
+
+
+def test_rotating_handler_rolls_on_size(tmp_path):
+    path = str(tmp_path / "logs" / "node.log")
+    handler = setup_logging(level="INFO", log_file=path,
+                            max_bytes=2000, backup_count=3)
+    try:
+        log = getlogger("rotation-test")
+        for i in range(200):
+            log.info("a log line long enough to force rollovers %04d "
+                     "xxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxx", i)
+        files = os.listdir(tmp_path / "logs")
+        assert "node.log" in files
+        assert len(files) > 1, files  # rotated at least once
+        assert os.path.getsize(path) <= 4000  # active file stays bounded
+        # backup_count caps retention: active file + at most 3 backups
+        assert len(files) <= 4, files
+    finally:
+        logging.getLogger().removeHandler(handler)
+        handler.close()
+
+
+def test_setup_logging_applies_config_level(tmp_path):
+    logger = logging.getLogger("verbosity-test-root")
+    handler = setup_logging(level="WARNING",
+                            log_file=str(tmp_path / "v.log"),
+                            logger=logger)
+    try:
+        assert logger.level == logging.WARNING
+    finally:
+        logger.removeHandler(handler)
+        handler.close()

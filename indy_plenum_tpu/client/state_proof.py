@@ -63,6 +63,19 @@ def verify_proved_reply(reply: StateProofReply,
         return False
     if ms.value.state_root_hash != b58encode(reply.root):
         return False
+    return verify_pool_multi_sig(ms, pool_bls_keys, min_participants,
+                                 now=now, max_age=max_age)
+
+
+def verify_pool_multi_sig(ms: MultiSignature,
+                          pool_bls_keys: Dict[str, str],
+                          min_participants: int,
+                          now: Optional[float] = None,
+                          max_age: Optional[float] = None) -> bool:
+    """True iff ``ms`` is a genuine >=min_participants co-signature by
+    pool members over its own value (roots + timestamp). Shared by proved
+    reads and the observer plane — anything that trusts a pool-signed
+    root goes through here."""
     if now is not None and max_age is not None:
         ts = ms.value.timestamp
         if not isinstance(ts, (int, float)) or now - ts > max_age:

@@ -109,8 +109,13 @@ def setup_logging(level: str = "INFO",
             h.close()
     handler = TimeAndSizeRotatingFileHandler(
         log_file, when=when, interval=interval,
-        backup_count=backup_count, max_bytes=max_bytes)
-    handler.setFormatter(logging.Formatter(DEFAULT_FORMAT))
-    handler.converter = time.gmtime
+        backup_count=backup_count, max_bytes=max_bytes, utc=True)
+    formatter = logging.Formatter(DEFAULT_FORMAT)
+    # UTC everywhere: %(asctime)s goes through the FORMATTER's converter
+    # (a converter on the handler is read by nothing), and utc=True keeps
+    # rollover filenames consistent — cross-node log correlation breaks
+    # the moment hosts disagree on timezone
+    formatter.converter = time.gmtime
+    handler.setFormatter(formatter)
     root.addHandler(handler)
     return handler

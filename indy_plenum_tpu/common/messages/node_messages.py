@@ -269,6 +269,31 @@ class CatchupRep(MessageBase):
 
 
 @register
+class ObservedData(MessageBase):
+    """One committed batch pushed to a non-validator observer.
+
+    Reference: plenum/server/observer/ (``ObservedData`` + the
+    each-batch sync policy). Proof-carrying redesign: the attached pool
+    BLS multi-signature co-signs BOTH the state root and the txn root of
+    the batch, so an observer holding the pool's BLS keys can trust ONE
+    validator's push — it re-applies the txns and checks its own
+    recomputed roots against the co-signed ones. Without BLS an observer
+    falls back to f+1 identical pushes from distinct validators.
+    """
+
+    typename = "OBSERVED_DATA"
+    schema = (
+        ("ledgerId", LedgerIdField()),
+        ("ppSeqNo", NonNegativeNumberField()),
+        ("ppTime", TimestampField()),
+        ("txns", IterableField(AnyField())),
+        ("stateRootHash", MerkleRootField(nullable=True)),
+        ("txnRootHash", MerkleRootField(nullable=True)),
+        ("multiSignature", AnyField(optional=True, nullable=True)),
+    )
+
+
+@register
 class Reply(MessageBase):
     """Node -> client: the committed txn for an executed request
     (reference: plenum/common/messages/node_messages.py Reply)."""

@@ -57,6 +57,8 @@ class ConsProofService:
             = None
         # (size, root_b58) -> senders with a VERIFIED proof / equal status
         self._votes: Dict[Target, Set[str]] = {}
+        # (size, root_b58) below our size -> prefix-matching behind peers
+        self._behind_votes: Dict[Target, Set[str]] = {}
         self._divergence_votes: Set[str] = set()
         self._own_size = 0
         self._own_root_b58 = ""
@@ -78,6 +80,7 @@ class ConsProofService:
         self._own_size = ledger.size
         self._own_root_b58 = b58encode(ledger.root_hash)
         self._votes.clear()
+        self._behind_votes.clear()
         self._divergence_votes.clear()
         self._on_target = on_target
         self._running = True
@@ -123,7 +126,18 @@ class ConsProofService:
             # would convict healthy nodes against fresh peers)
             ours_at = b58encode(ledger.root_hash_at(status.txnSeqNo))
             if status.merkleRoot == ours_at:
-                self._add_vote((status.txnSeqNo, status.merkleRoot), sender)
+                # prefix matches: the peer is merely behind. These become
+                # a BELOW-us truncation target only under a STRONG quorum
+                # (n-f distinct peers at the same tip) — with weak (f+1)
+                # support, one byzantine peer plus ordinary replication
+                # lag could make a caught-up node discard a batch it
+                # legitimately committed (review finding); n-f peers at
+                # the same tip means no quorum ever EXECUTED past it, so
+                # the truncated tail is re-orderable, not lost history
+                self._behind_votes.setdefault(
+                    (status.txnSeqNo, status.merkleRoot),
+                    set()).add(sender)
+                self._check_done()
             else:
                 self._add_divergence_vote(sender)
             return
@@ -183,6 +197,14 @@ class ConsProofService:
             if quorums.weak.is_reached(len(senders)):
                 if best is None or target[0] > best[0]:
                     best = target
+        if best is None:
+            # no at-or-above target: a STRONG quorum of prefix-matching
+            # behind peers (we are ahead of the whole pool) pins the
+            # pool's tip as the target instead
+            for target, senders in self._behind_votes.items():
+                if quorums.strong.is_reached(len(senders)):
+                    if best is None or target[0] > best[0]:
+                        best = target
         if best is not None:
             self._finish(best, diverged=False)
 

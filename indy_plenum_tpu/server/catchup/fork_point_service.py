@@ -179,7 +179,10 @@ class ForkPointService:
             self._tip_votes.setdefault(key, set()).add(sender)
             quorums = self._quorums()
             for (tip, root), senders in self._tip_votes.items():
-                if quorums.weak.is_reached(len(senders)):
+                # STRONG quorum: settling the search below the probe
+                # truncates past the pool tip, the same commitment a
+                # below-us catchup target makes (see cons_proof_service)
+                if quorums.strong.is_reached(len(senders)):
                     # root_hash_at(0) = the RFC 6962 empty-tree hash
                     ours = b58encode(self._ledger.root_hash_at(tip))
                     if root == ours:

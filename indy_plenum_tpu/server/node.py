@@ -355,6 +355,12 @@ class Node:
         self.notifier = NotifierService(name, self.internal_bus,
                                         timer=timer)
 
+        # --- observers: committed batches pushed to read replicas --------
+        from .observer import ObserverRegistry
+
+        self.observer_registry = ObserverRegistry(
+            self.external_bus, find_multi_sig=self._find_multi_sig)
+
         # --- plugins (LAST: entries get a fully constructed node) -------
         from ..plugins import load_plugins
 
@@ -645,9 +651,11 @@ class Node:
         ledger = self.boot.db.get_ledger(staged.ledger_id)
         valid = list(staged.batch.valid_digests)
         first_seq = ledger.size - len(valid) + 1
+        committed_txns: List[Dict] = []
         for offset, digest in enumerate(valid):
             seq_no = first_seq + offset
             txn = ledger.get_by_seq_no(seq_no)
+            committed_txns.append(txn)
             if staged.ledger_id == POOL_LEDGER_ID:
                 # membership authority: committed NODE txns reconfigure
                 self.pool_manager.process_committed_txn(txn)
@@ -664,6 +672,10 @@ class Node:
             self.replies[digest] = reply
             self._to_client(self._req_clients.pop(digest, None), reply)
         self.propagator.gc(list(ordered.reqIdr))
+        # read replicas get every committed batch (each-batch sync policy)
+        self.observer_registry.push_batch(
+            staged.ledger_id, ordered.ppSeqNo, ordered.ppTime,
+            committed_txns, ordered.stateRootHash, ordered.txnRootHash)
 
     def _on_catchup_finished(self, msg: CatchupFinished, *args) -> None:
         self.executed_upto = max(self.executed_upto,

@@ -1,0 +1,166 @@
+"""Observers: non-validator read replicas (the last SURVEY §2.5 row).
+
+Reference: plenum/server/observer/ (ObserverSyncPolicyEachBatch,
+ObservedData). The redesign is proof-carrying: with the pool's BLS keys
+one validator's push suffices (multi-sig over state+txn roots, re-applied
+and re-checked locally); without them, f+1 identical pushes.
+"""
+from indy_plenum_tpu.common.constants import DOMAIN_LEDGER_ID
+from indy_plenum_tpu.common.messages.node_messages import ObservedData
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.server.observer import Observer
+from indy_plenum_tpu.simulation.node_pool import NodePool
+from indy_plenum_tpu.utils.base58 import b58encode
+
+
+def make_observer(pool, name="observer1", bls=True, weak_quorum=1,
+                  feeders=None):
+    observer = Observer(
+        name, pool.network,
+        pool_bls_keys=({n: pk for n, (kp, pk, pop)
+                        in pool.bls_keys.items()} if bls else None),
+        weak_quorum=weak_quorum,
+        pool_genesis=([dict(t) for t in pool.pool_genesis]
+                      if pool.pool_genesis else None),
+        domain_genesis=[dict(t) for t in pool._domain_genesis])
+    pool.network.connect_all()
+    for node in (feeders if feeders is not None else pool.nodes):
+        node.observer_registry.add(name)
+    return observer
+
+
+def test_observer_applies_batches_with_bls_proof():
+    """BLS mode: every validator pushes; the observer verifies the pool
+    multi-signature on ONE push, re-applies, and matches roots."""
+    pool = NodePool(4, seed=81, bls=True)
+    observer = make_observer(pool, bls=True)
+
+    reqs = [pool.make_nym_request() for _ in range(3)]
+    for i, req in enumerate(reqs):
+        pool.submit_to(f"node{i % 4}", req)
+    pool.run_for(20)
+
+    assert observer.batches_applied >= 1
+    v_ledger = pool.nodes[0].boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    o_ledger = observer.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert o_ledger.size == v_ledger.size
+    assert o_ledger.root_hash == v_ledger.root_hash
+    # reads work on the replica
+    for req in reqs:
+        data = observer.get_nym_data(req.operation["dest"])
+        assert data is not None
+        assert data["verkey"] == req.operation["verkey"]
+    # and its state matches the validators'
+    assert observer.boot.db.get_state(
+        DOMAIN_LEDGER_ID).committed_head_hash == pool.nodes[0].boot.db.\
+        get_state(DOMAIN_LEDGER_ID).committed_head_hash
+
+
+def test_observer_rejects_tampered_push():
+    """A forged push (content not matching the co-signed roots) must not
+    corrupt the replica — even from an 'authenticated' feeder."""
+    pool = NodePool(4, seed=82, bls=True)
+    observer = make_observer(pool, bls=True)
+    req = pool.make_nym_request()
+    pool.submit_to("node0", req)
+    pool.run_for(15)
+    assert observer.batches_applied >= 1
+    good_root = observer.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+
+    # forge: a future batch with fake txns and NO valid multi-sig
+    forged = ObservedData(
+        ledgerId=DOMAIN_LEDGER_ID,
+        ppSeqNo=observer.last_applied_pp_seq_no + 1,
+        ppTime=1_700_000_000,
+        txns=[{"fake": 1}],
+        stateRootHash=b58encode(b"\x01" * 32),
+        txnRootHash=b58encode(b"\x02" * 32),
+        multiSignature=None,
+    )
+    observer.process_observed_data(forged, "node0")
+    assert observer.boot.db.get_ledger(
+        DOMAIN_LEDGER_ID).root_hash == good_root
+
+    # the pool keeps feeding honest batches afterwards
+    req2 = pool.make_nym_request()
+    pool.submit_to("node1", req2)
+    pool.run_for(15)
+    assert observer.get_nym_data(req2.operation["dest"]) is not None
+
+
+def test_observer_quorum_mode_without_bls():
+    """No BLS keys: a single push is NOT trusted; f+1 identical pushes
+    from distinct validators are."""
+    pool = NodePool(4, seed=83)
+    observer = make_observer(pool, bls=False, weak_quorum=2,
+                             feeders=[pool.nodes[0]])
+    req = pool.make_nym_request()
+    pool.submit_to("node0", req)
+    pool.run_for(15)
+    # only ONE feeder: below quorum, nothing applied
+    assert observer.batches_applied == 0
+    assert observer.get_nym_data(req.operation["dest"]) is None
+
+    # a second distinct feeder arrives and re-pushes matching content
+    pool.nodes[1].observer_registry.add("observer1")
+    req2 = pool.make_nym_request()
+    pool.submit_to("node1", req2)
+    pool.run_for(15)
+    # the new batch reached quorum, but the FIRST batch still blocks the
+    # order (only node0 pushed it) — resend it from node1's ledger
+    v_ledger = pool.nodes[1].boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    first = pool.nodes[1].ordered_log[0]
+    txn = v_ledger.get_by_seq_no(v_ledger.size - 1)
+    observer.process_observed_data(ObservedData(
+        ledgerId=DOMAIN_LEDGER_ID,
+        ppSeqNo=first.ppSeqNo,
+        ppTime=first.ppTime,
+        txns=[txn],
+        stateRootHash=first.stateRootHash,
+        txnRootHash=first.txnRootHash,
+        multiSignature=None,
+    ), "node1")
+    pool.run_for(5)
+    assert observer.batches_applied >= 2
+    assert observer.get_nym_data(req.operation["dest"]) is not None
+    assert observer.get_nym_data(req2.operation["dest"]) is not None
+
+
+def test_late_observer_catches_up_via_gap_detection():
+    """An observer registered AFTER the pool has committed batches can
+    never receive the missed pushes (validators push each batch exactly
+    once) — the gap watchdog runs the ordinary catchup plane against the
+    validators' seeders and the replica converges anyway."""
+    pool = NodePool(4, seed=84, bls=True)
+    early = [pool.make_nym_request() for _ in range(2)]
+    for i, req in enumerate(early):
+        pool.submit_to(f"node{i % 4}", req)
+    pool.run_for(15)
+
+    observer = Observer(
+        "late-observer", pool.network,
+        pool_bls_keys={n: pk for n, (kp, pk, pop)
+                       in pool.bls_keys.items()},
+        domain_genesis=[dict(t) for t in pool._domain_genesis],
+        timer=pool.timer, pool_size=4, gap_timeout=2.0)
+    pool.network.connect_all()
+    for node in pool.nodes:
+        node.observer_registry.add("late-observer")
+
+    # a live batch arrives with a ppSeqNo gap -> stash -> watchdog ->
+    # catchup against the seeders -> replica converges
+    late = pool.make_nym_request()
+    pool.submit_to("node2", late)
+    pool.run_for(20)
+
+    assert observer.catchups >= 1
+    v = pool.nodes[0].boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    o = observer.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert o.size == v.size and o.root_hash == v.root_hash
+    for req in early + [late]:
+        assert observer.get_nym_data(req.operation["dest"]) is not None
+    # and it keeps following LIVE pushes afterwards
+    after = pool.make_nym_request()
+    pool.submit_to("node3", after)
+    pool.run_for(10)
+    assert observer.get_nym_data(after.operation["dest"]) is not None

@@ -201,7 +201,11 @@ def test_sqlite_kv_at_reference_scale(tmp_path):
     from indy_plenum_tpu.storage.kv_store import KeyValueStorageSqlite
 
     store = KeyValueStorageSqlite(str(tmp_path), "scale")
-    n = 1_000_000
+    # full 1M-key reference scale only under the strict-bench flag; the
+    # default suite runs a 100k-key correctness pass (same code paths,
+    # ~10x cheaper) so CI time is not spent re-measuring a constant
+    strict = bool(os.environ.get("INDY_TPU_STRICT_BENCH"))
+    n = 1_000_000 if strict else 100_000
     batch = 10_000
     t0 = _time.perf_counter()
     for start in range(0, n, batch):
@@ -232,6 +236,6 @@ def test_sqlite_kv_at_reference_scale(tmp_path):
     # sustained (north-star 10x = ~10k). Hard throughput floors only
     # outside shared/loaded CI (a slow runner must not fail the suite);
     # correctness (size/scan counts) is asserted unconditionally above.
-    if os.environ.get("INDY_TPU_STRICT_BENCH"):
+    if strict:
         assert writes_per_sec > 50_000, writes_per_sec
         assert reads_per_sec > 20_000, reads_per_sec

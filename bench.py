@@ -247,6 +247,30 @@ def bench_catchup_proofs() -> dict:
     spread, median = _spread(times)
     value = batch / median
 
+    # kernel-only: pre-packed + device-resident args, pure verify time
+    # (end-to-end above additionally pays host packing + the host->device
+    # transfer — on this REMOTE device link the transfer dominates)
+    import jax
+    import jax.numpy as jnp
+
+    from indy_plenum_tpu.server.catchup.catchup_rep_service import (
+        pack_audit_batch,
+    )
+    from indy_plenum_tpu.tpu.sha256 import verify_audit_paths_indexed
+
+    packed = tuple(jax.device_put(jnp.asarray(a))
+                   for a in pack_audit_batch(data, idxs, paths,
+                                             tree_size, root))
+    assert np.asarray(verify_audit_paths_indexed(*packed))[:batch].all()
+    ktimes = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        _retry(lambda: verify_audit_paths_indexed(
+            *packed)[0].block_until_ready())
+        ktimes.append(time.perf_counter() - t0)
+    kspread, kmedian = _spread(ktimes)
+    kernel_value = batch / kmedian
+
     # honest same-machine host baseline over a sample, scaled
     sample = 512
     v = MerkleVerifier()
@@ -258,12 +282,18 @@ def bench_catchup_proofs() -> dict:
     return {
         "metric": "catchup_audit_proofs_per_sec",
         "value": round(value, 1),
-        "unit": "proofs/sec",
-        "vs_baseline": round(value / host_per_sec, 3),
-        "baseline_note": "vs host scalar verifier on this machine "
-                         f"({round(host_per_sec, 1)}/sec; host CPU has "
-                         "SHA-NI — the device path is an offload that "
-                         "frees the protocol thread, not a raw-SHA win)",
+        "unit": "proofs/sec (end-to-end: packing + transfer + verify)",
+        "vs_baseline": round(kernel_value / host_per_sec, 3),
+        "baseline_note": "vs_baseline compares the DEVICE KERNEL "
+                         f"({round(kernel_value, 1)}/sec on-device) to the "
+                         "host scalar verifier on this machine "
+                         f"({round(host_per_sec, 1)}/sec, SHA-NI). "
+                         "End-to-end (the `value`) additionally pays host "
+                         "packing and the remote-link transfer; "
+                         "see catchup_offload_ordered_txns_ratio for what "
+                         "that means in a live node loop",
+        "kernel_proofs_per_sec": round(kernel_value, 1),
+        "kernel_spread": kspread,
         "tree_size": tree_size,
         "batch": batch,
         "spread": spread,

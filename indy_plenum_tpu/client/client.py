@@ -18,7 +18,7 @@ import logging
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..common.constants import GET_NYM, TARGET_NYM
+from ..common.constants import GET_NYM, GET_TXN, TARGET_NYM
 from ..common.messages.node_messages import Reply, RequestAck, RequestNack
 from ..common.request import Request
 from ..utils.base58 import b58decode
@@ -179,6 +179,14 @@ class Client:
                 logger.warning("client %s: unverifiable proved reply "
                                "from %s dropped", self.name, node_name)
             return
+        if state.request.txn_type == GET_TXN and state.result is None:
+            # a single reply may carry an audit proof + the pool's
+            # multi-signature over this ledger root — as trustworthy as
+            # f+1 matching replies, which remain the fallback
+            if self._verify_proved_get_txn(state.request, result):
+                self.proved_reads[digest] = result
+                state.result = result
+                return
         state.add_reply(node_name, result)
 
     def _verify_proved_read(self, request: Request, result: dict,
@@ -201,6 +209,56 @@ class Client:
         return verify_proved_reply(
             reply, self._pool_bls_keys, min_participants=n - self._f,
             now=self._now(), max_age=self._proof_max_age)
+
+    def _verify_proved_get_txn(self, request: Request,
+                               result: dict) -> bool:
+        """Audit path -> ledger root co-signed by the pool => one node's
+        GET_TXN answer suffices (reference: clients verify proofs rather
+        than counting replies whenever proof material exists)."""
+        proof = result.get("auditProof") or {}
+        ms_dict = proof.get("multi_signature")
+        txn = result.get("data")
+        seq_no = result.get("seqNo")
+        if not ms_dict or txn is None or not isinstance(seq_no, int):
+            return False
+        if seq_no != request.operation.get("data"):
+            return False  # answers the seqNo WE asked about, or nothing
+        from ..common.constants import DOMAIN_LEDGER_ID
+
+        if result.get("ledgerId") != request.operation.get(
+                "ledgerId", DOMAIN_LEDGER_ID):
+            return False  # and from the ledger WE asked about: a genuine
+            # proof over the WRONG ledger's txn must not slip through
+        try:
+            from ..common.serializers.serialization import (
+                ledger_txn_serializer,
+            )
+            from ..crypto.bls.bls_crypto import MultiSignature
+            from ..ledger.merkle_verifier import STH, MerkleVerifier
+
+            ms = MultiSignature.from_dict(ms_dict)
+            root_b58 = proof["rootHash"]
+            if ms.value.txn_root_hash != root_b58 \
+                    or ms.value.ledger_id != result.get("ledgerId"):
+                return False
+            size = int(proof["ledgerSize"])
+            path = [b58decode(h) for h in proof["auditPath"]]
+            sth = STH(tree_size=size, sha256_root_hash=b58decode(root_b58))
+            if not MerkleVerifier().verify_leaf_inclusion(
+                    ledger_txn_serializer.dumps(txn), seq_no - 1, path,
+                    sth):
+                return False
+            from .state_proof import verify_pool_multi_sig
+
+            pool_keys = self._pool_bls_keys
+            if not pool_keys:
+                return False
+            n = len(self._validators)
+            return verify_pool_multi_sig(
+                ms, pool_keys, min_participants=n - self._f,
+                now=self._now(), max_age=self._proof_max_age)
+        except Exception:  # noqa: BLE001 — reply content is untrusted
+            return False
 
     # ------------------------------------------------------------------
 

@@ -85,13 +85,30 @@ def dispatch_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
             for d, i, p in zip(leaf_data, indices, paths)], bool)
         return lambda: host
 
-    from ...ledger.tree_hasher import TreeHasher
     from ...tpu.sha256 import verify_audit_paths_indexed
 
-    hasher = TreeHasher()
-    if any(len(p) > _MAX_DEPTH for p in paths):
+    packed = pack_audit_batch(leaf_data, indices, paths, tree_size, root)
+    if packed is None:
         bad = np.zeros(n, bool)
         return lambda: bad
+    ok_future = verify_audit_paths_indexed(*packed)
+    return lambda: np.asarray(ok_future)[:n]
+
+
+def pack_audit_batch(leaf_data: List[bytes], indices: List[int],
+                     paths: List[List[bytes]], tree_size: int,
+                     root: bytes):
+    """Host-side assembly for the device kernel: bucketed padding, leaf
+    hashing, and sibling-node deduplication. Returns the positional args
+    of :func:`indy_plenum_tpu.tpu.sha256.verify_audit_paths_indexed`, or
+    None for malformed (too-deep) paths. Split out so the bench can time
+    packing+transfer and the kernel separately."""
+    from ...ledger.tree_hasher import TreeHasher
+
+    n = len(leaf_data)
+    hasher = TreeHasher()
+    if any(len(p) > _MAX_DEPTH for p in paths):
+        return None
     size = _bucket(n)
     # vectorized packing: one frombuffer over the concatenated path bytes +
     # a single fancy-index scatter (the per-node Python loop used to cost
@@ -129,9 +146,7 @@ def dispatch_audit_paths_batch(leaf_data: List[bytes], indices: List[int],
     ts = np.full(size, tree_size, np.int32)
     root_arr = np.ascontiguousarray(np.broadcast_to(
         np.frombuffer(root, np.uint8), (size, 32)))
-    ok_future = verify_audit_paths_indexed(
-        leaf, idx, table, path_idx, path_len, ts, root_arr)
-    return lambda: np.asarray(ok_future)[:n]
+    return leaf, idx, table, path_idx, path_len, ts, root_arr
 
 
 class CatchupRepService:

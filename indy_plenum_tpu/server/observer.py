@@ -44,7 +44,8 @@ class Observer:
                  domain_genesis: Optional[list] = None,
                  timer=None,
                  pool_size: Optional[int] = None,
-                 gap_timeout: float = 5.0):
+                 gap_timeout: float = 5.0,
+                 validators: Optional[list] = None):
         """``pool_bls_keys``: node name -> BLS pk b58 (trust anchor for
         single-push mode); ``weak_quorum``: f+1 of the pool, used when no
         BLS keys are available. With ``timer`` + ``pool_size`` the
@@ -57,6 +58,12 @@ class Observer:
             domain_genesis=domain_genesis).build()
         self._bls_keys = dict(pool_bls_keys or {})
         self._weak_quorum = max(1, weak_quorum)
+        # weak-quorum mode counts only VALIDATOR senders: without this,
+        # f+1 arbitrary connected peers (other observers, clients) could
+        # co-push fabricated batches whose self-consistent roots pass the
+        # re-apply check. BLS keys double as the validator set.
+        self._validators = set(validators) if validators is not None \
+            else set(self._bls_keys) or None
         self.bus = network.create_peer(name)
         self.bus.subscribe(ObservedData, self.process_observed_data)
         self.last_applied_pp_seq_no = self.boot.committed_pp_seq_no
@@ -97,17 +104,17 @@ class Observer:
                                              self._check_gap)
 
     def _check_gap(self) -> None:
-        """A stall (future batches stashed, predecessor never arriving)
-        that persists across two checks triggers catchup — validators
-        push each batch exactly once, so a missed push never resends."""
+        """A stall — stashed batches exist but nothing applied between
+        two checks — triggers catchup. That covers BOTH shapes: a missing
+        predecessor (validators push each batch exactly once, so a missed
+        push never resends) AND a present-but-untrusted head (e.g. a
+        BLS-mode push whose multi-signature was absent)."""
         if not self._stashed:
             self._gap_marker = None
             return
         marker = (self.last_applied_pp_seq_no, min(self._stashed))
-        if marker == self._gap_marker \
-                and marker[1] > marker[0] + 1 \
-                and self.leecher is not None:
-            logger.info("%s: push gap at %s; running catchup", self.name,
+        if marker == self._gap_marker and self.leecher is not None:
+            logger.info("%s: push stall at %s; running catchup", self.name,
                         marker)
             self.leecher.start()
             self._gap_marker = None
@@ -145,7 +152,13 @@ class Observer:
             return  # duplicate push (several validators feed us)
         if len(self._stashed) >= MAX_STASHED \
                 and data.ppSeqNo not in self._stashed:
-            return  # bounded: drop far-future floods
+            # bounded stash: evict the FARTHEST-future slot for a nearer
+            # batch (refusing the needed next-in-order push would let a
+            # far-future flood block honest traffic permanently)
+            farthest = max(self._stashed)
+            if data.ppSeqNo >= farthest:
+                return
+            del self._stashed[farthest]
         slot = self._stashed.setdefault(data.ppSeqNo, {})
         key = self._content_key(data)
         entry = slot.get(key)
@@ -174,6 +187,9 @@ class Observer:
                 # (or arrive) under a different content key
                 self.batches_rejected += 1
                 del slot[key]
+            if not slot:
+                del self._stashed[nxt]  # an empty slot must not mask the
+                # gap from the watchdog
             if not applied:
                 return  # wait for a proof / more matching pushes
             del self._stashed[nxt]
@@ -201,7 +217,9 @@ class Observer:
             return verify_pool_multi_sig(
                 ms, self._bls_keys,
                 min_participants=n - (n - 1) // 3)
-        return len(senders) >= self._weak_quorum
+        if self._validators is None:
+            return False  # weak mode with no validator set: trust nothing
+        return len(senders & self._validators) >= self._weak_quorum
 
     def _apply(self, data: ObservedData) -> bool:
         """Re-apply the batch and check our OWN roots against the

@@ -82,7 +82,13 @@ class ReadRequestManager:
         }
 
     def handle_get_txn(self, request: Request) -> Dict[str, Any]:
-        """A committed txn by seqNo + its audit path to the ledger root."""
+        """A committed txn by seqNo + its audit path to the ledger root.
+
+        When the pool runs BLS, the reply also carries the multi-signature
+        over the LATEST batch of this ledger — its co-signed
+        ``txn_root_hash`` is this ledger root, which upgrades GET_TXN to a
+        proved single-node read (the client checks audit path -> co-signed
+        root -> pool keys; see Client._verify_proved_get_txn)."""
         ledger_id = request.operation.get("ledgerId", DOMAIN_LEDGER_ID)
         seq_no = request.operation.get("data")
         if not isinstance(ledger_id, int):
@@ -100,6 +106,16 @@ class ReadRequestManager:
                     "seqNo": seq_no, "data": None}
         txn = ledger.get_by_seq_no(seq_no)
         size = ledger.size
+        multi_sig = None
+        state = self._db.get_state(ledger_id)
+        if state is not None:
+            ms = self._get_multi_sig(b58encode(state.committed_head_hash))
+            # only attach when it actually covers THIS ledger root (the
+            # store is keyed by state root; its value co-signs the txn
+            # root of the same batch)
+            if ms and ms.get("value", {}).get("txn_root_hash") \
+                    == b58encode(ledger.root_hash):
+                multi_sig = ms
         return {
             "type": GET_TXN,
             "ledgerId": ledger_id,
@@ -110,5 +126,6 @@ class ReadRequestManager:
                 "ledgerSize": size,
                 "auditPath": [b58encode(h)
                               for h in ledger.audit_path(seq_no, size)],
+                "multi_signature": multi_sig,
             },
         }

@@ -20,6 +20,7 @@ def make_observer(pool, name="observer1", bls=True, weak_quorum=1,
         pool_bls_keys=({n: pk for n, (kp, pk, pop)
                         in pool.bls_keys.items()} if bls else None),
         weak_quorum=weak_quorum,
+        validators=list(pool.validators),
         pool_genesis=([dict(t) for t in pool.pool_genesis]
                       if pool.pool_genesis else None),
         domain_genesis=[dict(t) for t in pool._domain_genesis])

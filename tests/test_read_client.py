@@ -203,3 +203,104 @@ def test_proved_reply_cannot_short_circuit_write_quorum():
     # the proved path is reserved for reads WE asked: the write stays
     # pending until real f+1 replies arrive
     assert client.result(d2) is None
+
+
+def test_wallet_lifecycle(tmp_path):
+    """Wallet (reference: plenum/client/wallet.py): identity creation,
+    fresh reqIds, request signing that the pool's authenticator accepts,
+    multi-sig endorsement, and 0600 persistence round-trip."""
+    import os
+    import stat
+
+    from indy_plenum_tpu.client.wallet import Wallet
+    from indy_plenum_tpu.common.constants import (
+        NYM, TARGET_NYM, TXN_TYPE, VERKEY,
+    )
+
+    pool = NodePool(4, seed=45)
+    wallet = Wallet("w1")
+    # import the pool trustee + create a fresh local identity
+    wallet.add_signer(pool.trustee)
+    newcomer = wallet.add_identifier()
+    assert wallet.default_id == pool.trustee.identifier
+    assert len(wallet.identifiers) == 2
+
+    # fresh per-identifier reqIds, monotone
+    assert wallet.next_req_id() == 1
+    assert wallet.next_req_id() == 2
+    assert wallet.next_req_id(newcomer.identifier) == 1
+
+    # a wallet-built request authenticates and orders on the pool
+    req = wallet.new_request({TXN_TYPE: NYM,
+                              TARGET_NYM: newcomer.identifier,
+                              VERKEY: newcomer.verkey})
+    assert pool.submit_to("node0", req)
+    pool.run_for(15)
+    assert all(n.get_nym_data(newcomer.identifier) is not None
+               for n in pool.nodes)
+
+    # multi-sig endorsement adds per-identifier signatures
+    req2 = Request(identifier=pool.trustee.identifier,
+                   reqId=wallet.next_req_id(),
+                   operation={TXN_TYPE: NYM, TARGET_NYM: "X" * 16})
+    wallet.sign_request(req2)
+    wallet.endorse_request(req2, [newcomer.identifier])
+    assert newcomer.identifier in req2.signatures
+
+    # persistence: 0600 file, identical identities and reqId floors back
+    path = str(tmp_path / "wallet.json")
+    wallet.save(path)
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+    reloaded = Wallet.load(path)
+    assert set(reloaded.identifiers) == set(wallet.identifiers)
+    assert reloaded.default_id == wallet.default_id
+    assert reloaded.next_req_id() == wallet._req_ids[
+        wallet.default_id] + 1
+
+
+def test_get_txn_proved_single_node_read():
+    """With BLS on, a GET_TXN reply carries the audit path AND the pool
+    multi-signature over the ledger root: the client accepts ONE node's
+    answer without waiting for f+1 matching replies; a tampered reply
+    falls back to the quorum path instead of being trusted."""
+    import copy
+
+    pool = NodePool(4, seed=46, bls=True)
+    client = pool.make_client()
+    req, _ = _write_one_nym(pool, client)
+    seq_no = client.result(req.digest)["txnMetadata"]["seqNo"]
+
+    read = Request(identifier="reader", reqId=200,
+                   operation={TXN_TYPE: GET_TXN,
+                              "ledgerId": DOMAIN_LEDGER_ID,
+                              "data": seq_no})
+    node = pool.node("node2")
+    node.submit_client_request(read, client_id=client.name)
+    replies = [(c, m) for c, m in node.client_outbox if c == client.name]
+    node.client_outbox.clear()
+    (cid, reply) = replies[-1]
+    genuine = dict(reply.result)
+    assert genuine["auditProof"]["multi_signature"] is not None
+
+    # ONE verified reply suffices
+    client.submit_read(read, to="node2")
+    client.process_node_message("node2", reply)
+    assert client.result(read.digest) is not None
+    assert read.digest in client.proved_reads
+    assert client.result(read.digest)["data"]["txnMetadata"]["seqNo"] \
+        == seq_no
+
+    # tampering with the txn, the root, or the multi-sig breaks the chain
+    for mutate in (
+        lambda r: r.__setitem__("data", {"forged": True}),
+        lambda r: r["auditProof"].__setitem__(
+            "rootHash", r["auditProof"]["rootHash"][::-1]),
+        lambda r: r["auditProof"].__setitem__("multi_signature", None),
+    ):
+        bad = copy.deepcopy(genuine)
+        mutate(bad)
+        fresh = Request(identifier="reader", reqId=201 + id(mutate) % 97,
+                        operation={TXN_TYPE: GET_TXN,
+                                   "ledgerId": DOMAIN_LEDGER_ID,
+                                   "data": seq_no})
+        assert client._verify_proved_get_txn(fresh, bad) is False

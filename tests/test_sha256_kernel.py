@@ -71,3 +71,33 @@ def test_batched_audit_path_verify():
     expected = np.ones(B, bool)
     expected[[2, 5, 7]] = False
     assert list(ok) == list(expected)
+
+
+def test_merkle_node_hash_words_matches_hashlib():
+    """The TPU fast path's word-oriented double compression (grouped
+    unroll, shift-assembled message words) against the byte oracle —
+    the CPU backend only runs the portable fold in production, so this
+    pins the fast kernel's math on every platform."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from indy_plenum_tpu.tpu.sha256 import (
+        _bytes_to_words,
+        _merkle_node_hash_words,
+        _words_to_bytes,
+    )
+
+    rng = np.random.RandomState(3)
+    left = rng.randint(0, 256, (8, 32)).astype(np.uint8)
+    right = rng.randint(0, 256, (8, 32)).astype(np.uint8)
+    fn = jax.jit(lambda a, b: _merkle_node_hash_words(
+        _bytes_to_words(a), _bytes_to_words(b)))
+    out = np.asarray(_words_to_bytes(fn(jnp.asarray(left),
+                                        jnp.asarray(right))))
+    for i in range(len(left)):
+        expected = hashlib.sha256(
+            b"\x01" + left[i].tobytes() + right[i].tobytes()).digest()
+        assert out[i].tobytes() == expected

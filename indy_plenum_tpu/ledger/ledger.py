@@ -137,7 +137,9 @@ class Ledger:
         if size >= self.seq_no:
             return
         keep = [self.get_by_seq_no(s) for s in range(1, size + 1)]
-        for s in range(size + 1, self.seq_no + 1):
+        # descending: append-only stores (ChunkedFileStore) only support
+        # tail removal, and KV stores don't care about the order
+        for s in range(self.seq_no, size, -1):
             self.txn_store.remove(self._key(s))
         if self.tree.hash_store is not None:
             self.tree.hash_store.reset()

@@ -197,4 +197,12 @@ def initKeyValueStorage(storage_type: str, data_dir: str, name: str
         return KeyValueStorageInMemory()
     if storage_type == "sqlite":
         return KeyValueStorageSqlite(data_dir, name)
+    if storage_type == "chunked_file":
+        from .file_stores import ChunkedFileStore
+
+        return ChunkedFileStore(data_dir, name)
+    if storage_type == "text_file":
+        from .file_stores import TextFileStore
+
+        return TextFileStore(data_dir, name)
     raise StorageError(f"unknown storage type {storage_type}")

@@ -239,3 +239,93 @@ def test_sqlite_kv_at_reference_scale(tmp_path):
     if strict:
         assert writes_per_sec > 50_000, writes_per_sec
         assert reads_per_sec > 20_000, reads_per_sec
+
+
+def test_text_file_store_roundtrip_and_compaction(tmp_path):
+    """Reference: storage/text_file_store.py — human-readable KV with
+    tombstoned removals, surviving reopen and compaction."""
+    from indy_plenum_tpu.storage.file_stores import TextFileStore
+
+    store = TextFileStore(str(tmp_path), "kv")
+    store.put(b"a", b"1")
+    store.put(b"b", b"2")
+    store.put(b"a", b"3")  # overwrite
+    store.remove(b"b")
+    assert store.get(b"a") == b"3"
+    assert not store.has_key(b"b")
+    assert store.size == 1
+    store.close()
+
+    reopened = TextFileStore(str(tmp_path), "kv")  # replayed from disk
+    assert reopened.get(b"a") == b"3"
+    assert not reopened.has_key(b"b")
+    reopened.compact()
+    assert reopened.get(b"a") == b"3"
+    assert list(reopened.iterator()) == [(b"a", b"3")]
+    reopened.close()
+
+
+def test_ledger_runs_on_chunked_file_store(tmp_path):
+    """Reference: storage/chunked_file_store.py — the original ledger
+    persistence. A Ledger writes/commits/truncates through it, chunk
+    files split at the configured size, and a reopened store serves the
+    same committed history (the restart path)."""
+    from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from indy_plenum_tpu.ledger.ledger import Ledger
+    from indy_plenum_tpu.storage.file_stores import ChunkedFileStore
+
+    store = ChunkedFileStore(str(tmp_path), "domain", chunk_size=4)
+    ledger = Ledger(tree=CompactMerkleTree(), txn_store=store)
+    for i in range(10):
+        ledger.add({"k": i})
+    assert store.size == 10
+    import os
+
+    chunks = [f for f in os.listdir(tmp_path / "domain")
+              if f.endswith(".chunk")]
+    assert len(chunks) == 3  # 4 + 4 + 2
+    root_10 = ledger.root_hash
+
+    # tail truncation (catchup's reset_to path)
+    ledger.reset_to(6)
+    assert store.size == 6
+    for i in range(6, 10):
+        ledger.add({"k": i})
+    assert ledger.root_hash == root_10
+
+    # restart: a fresh store over the same directory serves the history
+    reopened = ChunkedFileStore(str(tmp_path), "domain", chunk_size=4)
+    assert reopened.size == 10
+    ledger2 = Ledger(tree=CompactMerkleTree(), txn_store=reopened)
+    # the tree is rebuilt separately in production (hash store); here we
+    # only assert the txn log round-trips
+    assert reopened.get((3).to_bytes(8, "big")) == store.get(
+        (3).to_bytes(8, "big"))
+
+    # append-only discipline is enforced, not silently corrupted
+    import pytest
+
+    with pytest.raises(ValueError):
+        store.put((20).to_bytes(8, "big"), b"x")
+    with pytest.raises(ValueError):
+        store.remove((3).to_bytes(8, "big"))
+
+
+def test_chunked_store_batch_validates_before_applying(tmp_path):
+    """An invalid batch (gap in the append order) must leave memory AND
+    disk untouched — the KV contract's atomicity, enforced by checking
+    the whole batch before the first mutation."""
+    import pytest
+
+    from indy_plenum_tpu.storage.file_stores import ChunkedFileStore
+
+    store = ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
+    store.do_batch(((i).to_bytes(8, "big"), b"v%d" % i)
+                   for i in range(1, 4))
+    assert store.size == 3
+    with pytest.raises(ValueError):
+        store.do_batch([((4).to_bytes(8, "big"), b"v4"),
+                        ((7).to_bytes(8, "big"), b"gap")])
+    assert store.size == 3  # nothing from the bad batch landed
+    reopened = ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
+    assert reopened.size == 3  # disk agrees

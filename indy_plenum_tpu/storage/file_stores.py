@@ -77,24 +77,35 @@ class TextFileStore(KeyValueStorage):
 
     def do_batch(self, batch: Iterable[Tuple[bytes, Optional[bytes]]]
                  ) -> None:
-        for key, value in batch:
+        # convert the WHOLE batch before mutating anything: a bad entry
+        # mid-batch must not leave earlier entries applied (the KV
+        # contract's atomicity)
+        entries = [(bytes(_to_bytes(key)),
+                    None if value is None else bytes(_to_bytes(value)))
+                   for key, value in batch]
+        for key, value in entries:
             if value is None:
-                self.remove(key)
+                self._index.pop(key, None)
             else:
-                key, value = bytes(_to_bytes(key)), bytes(_to_bytes(value))
                 self._index[key] = value
-                self._append(key, value)
+            self._append(key, value)
         self._fh.flush()
 
     def compact(self) -> None:
-        """Rewrite the file with only live records (tombstone GC)."""
+        """Rewrite the file with only live records (tombstone GC). A
+        failed rewrite (disk full) leaves the original file intact and
+        the store usable."""
         self._fh.close()
         tmp = self._path + ".compact"
-        with open(tmp, "w") as fh:
-            for key in sorted(self._index):
-                fh.write(f"{key.hex()}\t{self._index[key].hex()}\n")
-        os.replace(tmp, self._path)
-        self._fh = open(self._path, "a")
+        try:
+            with open(tmp, "w") as fh:
+                for key in sorted(self._index):
+                    fh.write(f"{key.hex()}\t{self._index[key].hex()}\n")
+            os.replace(tmp, self._path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            self._fh = open(self._path, "a")
 
     def close(self) -> None:
         self._fh.close()
@@ -125,6 +136,17 @@ class ChunkedFileStore(KeyValueStorage):
             raise ValueError("chunk_size must be positive")
         self._dir = os.path.join(db_dir, db_name)
         os.makedirs(self._dir, exist_ok=True)
+        # chunk_size is part of the ON-DISK layout: reopening with a
+        # different value would silently corrupt the seq->chunk
+        # arithmetic, so the persisted value always wins and the ctor
+        # argument only seeds NEW stores
+        meta = os.path.join(self._dir, "chunk_size")
+        if os.path.exists(meta):
+            with open(meta) as fh:
+                chunk_size = int(fh.read().strip())
+        else:
+            with open(meta, "w") as fh:
+                fh.write(str(chunk_size))
         self._chunk_size = chunk_size
         # chunk i holds entries [i*chunk_size + 1, (i+1)*chunk_size]
         self._chunks: Dict[int, list] = {}

@@ -294,11 +294,12 @@ def test_ledger_runs_on_chunked_file_store(tmp_path):
     assert ledger.root_hash == root_10
 
     # restart: a fresh store over the same directory serves the history
-    reopened = ChunkedFileStore(str(tmp_path), "domain", chunk_size=4)
+    # (the tree is rebuilt separately in production via the hash store;
+    # only the txn log round-trip is asserted here). The ctor's
+    # chunk_size is IGNORED on reopen — the on-disk layout wins
+    reopened = ChunkedFileStore(str(tmp_path), "domain", chunk_size=999)
     assert reopened.size == 10
-    ledger2 = Ledger(tree=CompactMerkleTree(), txn_store=reopened)
-    # the tree is rebuilt separately in production (hash store); here we
-    # only assert the txn log round-trips
+    assert reopened._chunk_size == 4
     assert reopened.get((3).to_bytes(8, "big")) == store.get(
         (3).to_bytes(8, "big"))
 

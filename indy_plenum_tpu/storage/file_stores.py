@@ -143,10 +143,18 @@ class ChunkedFileStore(KeyValueStorage):
         meta = os.path.join(self._dir, "chunk_size")
         if os.path.exists(meta):
             with open(meta) as fh:
-                chunk_size = int(fh.read().strip())
+                raw = fh.read().strip()
+            persisted = int(raw) if raw.isdigit() else 0
+            if persisted <= 0:
+                raise ValueError(
+                    f"corrupt chunk_size meta at {meta!r}: {raw!r}")
+            chunk_size = persisted
         else:
-            with open(meta, "w") as fh:
+            # tmp+rename: a crash mid-write must not leave a truncated
+            # meta that bricks every future open
+            with open(meta + ".tmp", "w") as fh:
                 fh.write(str(chunk_size))
+            os.replace(meta + ".tmp", meta)
         self._chunk_size = chunk_size
         # chunk i holds entries [i*chunk_size + 1, (i+1)*chunk_size]
         self._chunks: Dict[int, list] = {}
@@ -288,6 +296,11 @@ class ChunkedFileStore(KeyValueStorage):
                 os.unlink(path)
         self._chunks.clear()
         self._count = 0
+        # the layout parameter belongs to the DATA; with the data gone a
+        # later store over this directory must get its own chunk_size
+        meta = os.path.join(self._dir, "chunk_size")
+        if os.path.exists(meta):
+            os.unlink(meta)
 
     @property
     def size(self) -> int:

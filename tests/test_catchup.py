@@ -410,3 +410,68 @@ def test_node_ahead_of_pool_with_corrupt_tail_recovers():
     pool.run_for(8)
     assert len(set(domain_roots(pool))) == 1
     assert len(set(domain_sizes(pool))) == 1
+
+
+def test_probe_statuses_are_never_status_evidence():
+    """A fork-search PROBE carries a root from a possibly-corrupt prefix
+    and is wire-marked as a question: neither the cons-proof plane nor
+    another fork search may count it as a divergence accusation or a tip
+    vote — a diverged prober must not be able to convict healthy nodes."""
+    from indy_plenum_tpu.common.event_bus import ExternalBus
+    from indy_plenum_tpu.common.messages.node_messages import LedgerStatus
+    from indy_plenum_tpu.common.timer import QueueTimer
+    from indy_plenum_tpu.server.catchup.cons_proof_service import (
+        ConsProofService,
+    )
+    from indy_plenum_tpu.server.catchup.fork_point_service import (
+        ForkPointService,
+    )
+    from indy_plenum_tpu.server.database_manager import DatabaseManager
+    from indy_plenum_tpu.server.quorums import Quorums
+    from indy_plenum_tpu.utils.base58 import b58encode
+
+    ledger = Ledger()
+    for i in range(8):
+        ledger.add({"k": i})
+    db = DatabaseManager()
+    db.register_new_database(1, ledger, None)
+    bus = ExternalBus(lambda msg, dst=None: None)
+    timer = QueueTimer()
+    quorums = Quorums(4)
+
+    service = ConsProofService(1, bus, timer, db,
+                               quorums_provider=lambda: quorums)
+    outcome = []
+    service.start(lambda target, diverged: outcome.append(
+        (target, diverged)))
+
+    corrupt_root = b58encode(b"\x07" * 32)
+    probe = LedgerStatus(ledgerId=1, txnSeqNo=4, viewNo=None, ppSeqNo=None,
+                         merkleRoot=corrupt_root, protocolVersion=2,
+                         probe=True)
+    # f+1 diverged probers spamming probes: NOT evidence
+    service.process_ledger_status(probe, "evil1")
+    service.process_ledger_status(probe, "evil2")
+    service.process_ledger_status(probe, "evil3")
+    assert not service._divergence_votes
+    assert not outcome
+
+    # the SAME message as a genuine status IS evidence (prefix mismatch)
+    genuine = LedgerStatus(ledgerId=1, txnSeqNo=4, viewNo=None,
+                           ppSeqNo=None, merkleRoot=corrupt_root,
+                           protocolVersion=2)
+    service.process_ledger_status(genuine, "peer1")
+    assert len(service._divergence_votes) == 1
+
+    # the fork search ignores probes too (tip-vote channel)
+    fork = ForkPointService(1, bus, timer, db,
+                            quorums_provider=lambda: quorums)
+    found = []
+    fork.start(found.append)
+    fork._mid = 4
+    low_probe = LedgerStatus(ledgerId=1, txnSeqNo=2, viewNo=None,
+                             ppSeqNo=None, merkleRoot=corrupt_root,
+                             protocolVersion=2, probe=True)
+    for s in ("evil1", "evil2", "evil3"):
+        fork.process_ledger_status(low_probe, s)
+    assert not fork._tip_votes and not found

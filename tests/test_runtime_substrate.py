@@ -330,3 +330,30 @@ def test_chunked_store_batch_validates_before_applying(tmp_path):
     assert store.size == 3  # nothing from the bad batch landed
     reopened = ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
     assert reopened.size == 3  # disk agrees
+
+
+def test_chunked_store_meta_durability(tmp_path):
+    """chunk_size meta edge cases: corrupt/empty meta fails LOUDLY (not a
+    cryptic crash deep in chunk arithmetic), and drop() removes the meta
+    so a fresh store over the directory gets its own layout."""
+    import os
+
+    import pytest
+
+    from indy_plenum_tpu.storage.file_stores import ChunkedFileStore
+
+    store = ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
+    store.put((1).to_bytes(8, "big"), b"v")
+    store.drop()
+    fresh = ChunkedFileStore(str(tmp_path), "log", chunk_size=7)
+    assert fresh._chunk_size == 7  # stale layout did not leak
+
+    meta = os.path.join(str(tmp_path), "log", "chunk_size")
+    with open(meta, "w") as fh:
+        fh.write("")  # crash-truncated meta
+    with pytest.raises(ValueError, match="corrupt chunk_size"):
+        ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
+    with open(meta, "w") as fh:
+        fh.write("0")
+    with pytest.raises(ValueError, match="corrupt chunk_size"):
+        ChunkedFileStore(str(tmp_path), "log", chunk_size=4)

@@ -197,7 +197,10 @@ def bench_ordered_txns_n64_rbft() -> dict:
         metric="ordered_txns_per_sec_n64_rbft_full_instances",
         note="full RBFT: f+1=%d parallel instances; vs the same 100 "
              "txns/sec CPU estimate (reference also pays the instance "
-             "multiplier)" % f_plus_1)
+             "multiplier). NB: the simulation runs ALL %d validators' "
+             "host loops serially in one Python process — a deployed "
+             "pool runs one loop per host, so per-node load here is %dx "
+             "a real validator's" % (f_plus_1, n, n))
 
 
 def bench_ordered_txns_n100() -> dict:

@@ -118,6 +118,16 @@ class Client:
                 self._send(request, node, self.name)
         return request.digest
 
+    def submit_action(self, request: Request, to: Optional[str] = None
+                      ) -> str:
+        """Privileged operational actions (VALIDATOR_INFO, POOL_RESTART)
+        are point queries: each node answers for ITSELF, so one reply
+        from the asked node is the answer — no quorum to wait for."""
+        node = to or self._validators[0]
+        self._track(request, needed=1)
+        self._send(request, node, self.name)
+        return request.digest
+
     def _track(self, request: Request, needed: int) -> PendingRequest:
         """Register a pending request. (identifier, reqId) must be unique
         among in-flight requests — node replies carry only that pair, so

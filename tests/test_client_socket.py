@@ -134,3 +134,34 @@ def test_client_forged_signature_nacked_over_sockets(socket_pool):
     finally:
         looper.remove(stack)
         stack.close()
+
+
+def test_validator_info_action_over_sockets(socket_pool):
+    """Operational parity over the wire: a trustee asks ONE node for
+    VALIDATOR_INFO through the client socket and gets the status
+    snapshot (view, participation, ledger sizes, recent events) back as
+    a Reply — the reference's ops surface, reachable remotely."""
+    import time as _time
+
+    from indy_plenum_tpu.common.constants import VALIDATOR_INFO
+
+    directory, looper, nodes, trustee = socket_pool
+    client, stack = build_client(directory, "cli-ops")
+    looper.add(stack)
+    try:
+        req = Request(identifier=trustee.identifier, reqId=500,
+                      operation={TXN_TYPE: VALIDATOR_INFO,
+                                 "timestamp": _time.time()})
+        trustee.sign_request(req)
+        # actions are privileged point queries: ask one node
+        digest = client.submit_action(req, to="node1")
+        ok = looper.run_until(
+            lambda: client.result(digest) is not None, timeout=30)
+        assert ok, client.pending[digest].nacks
+        status = client.result(digest)["data"]
+        assert status["name"] == "node1"
+        assert status["is_participating"] is True
+        assert "ledger_sizes" in status and "recent_events" in status
+    finally:
+        looper.remove(stack)
+        stack.close()

@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..common.constants import GET_NYM, GET_TXN, TARGET_NYM
 from ..common.messages.node_messages import Reply, RequestAck, RequestNack
 from ..common.request import Request
+from ..common.txn_util import get_digest
 from ..utils.base58 import b58decode
 from .state_proof import StateProofReply, verify_proved_reply
 
@@ -139,9 +140,15 @@ class Client:
         if existing is not None:
             if existing.request.digest == request.digest:
                 return existing  # retry: resend, keep collected replies
+            # NOT auto-retired even when completed: the collision may be
+            # an application bug and the earlier result may be unread —
+            # silently dropping it would mask the bug as reply loss. The
+            # recovery path for legitimate reuse (wallet counter reset)
+            # is take_result()/retire(), which frees the slot.
             raise ValueError(
                 f"reqId {request.reqId} already used by a different "
-                f"request for {request.identifier}; pick a fresh reqId")
+                f"request for {request.identifier}; take_result()/"
+                f"retire() the old request or pick a fresh reqId")
         state = self.pending[request.digest] = PendingRequest(
             request, needed=needed)
         self._by_idr[key] = state
@@ -174,6 +181,16 @@ class Client:
         state = self._match_pending(result.get("identifier"),
                                     result.get("reqId"))
         if state is None:
+            return
+        reply_digest = get_digest(result)
+        if reply_digest is not None and \
+                reply_digest != state.request.digest:
+            # a straggler for a RETIRED request whose (identifier, reqId)
+            # slot was legitimately reused: counting it toward the NEW
+            # request's quorum would resolve it with the old result.
+            # Write replies carry the request digest in the txn envelope;
+            # replies without one fall through (reads validate against
+            # our own request's operation instead).
             return
         digest = state.request.digest
         # the single-reply proved path applies ONLY when WE asked a proved
@@ -275,6 +292,26 @@ class Client:
     def result(self, digest: str) -> Optional[dict]:
         state = self.pending.get(digest)
         return state.result if state else None
+
+    def take_result(self, digest: str) -> Optional[dict]:
+        """``result()`` + retire: the long-running-client shape. Returns
+        None (and retires nothing) while the quorum is still pending."""
+        res = self.result(digest)
+        if res is not None:
+            self.retire(digest)
+        return res
+
+    def retire(self, digest: str) -> None:
+        """Forget a request: frees its memory AND releases its
+        (identifier, reqId) slot for legitimate reuse. Without this a
+        long-running client grows without bound (round-4 advisor
+        finding). Late replies for a retired digest are dropped by the
+        normal unknown-request path."""
+        state = self.pending.pop(digest, None)
+        if state is not None:
+            self._by_idr.pop(
+                (state.request.identifier, state.request.reqId), None)
+        self.proved_reads.pop(digest, None)
 
     def is_rejected(self, digest: str) -> bool:
         state = self.pending.get(digest)

@@ -117,6 +117,12 @@ class ZStack:
         self._reconnect_interval = reconnect_interval
         self._last_reconnect_check = time.monotonic()
         self.reconnects = 0
+        # per-peer recreate pacing for NEVER-handshaken peers: the same
+        # grace the handshaken path gets, then exponential backoff — a
+        # slow-to-boot or slow-handshaking peer must not have its DEALER
+        # (and in-flight handshake) torn down every interval (round-4
+        # advisor finding). (attempts, earliest next recreate).
+        self._recreate_state: Dict[str, Tuple[int, float]] = {}
 
     # --- registry -------------------------------------------------------
 
@@ -187,6 +193,7 @@ class ZStack:
         # again — the KIT retry must be willing to recreate it
         self._handshaken.discard(name)
         self._down_since.pop(name, None)
+        self._recreate_state.pop(name, None)
 
     def _retry_dead_connections(self) -> None:
         """KIT reconnect pass: any peer without a completed handshake gets
@@ -212,6 +219,23 @@ class ZStack:
                 if down is None or now - down < grace:
                     continue
                 self._handshaken.discard(name)
+            else:
+                # never handshaken: give the in-flight attempt the same
+                # grace before tearing its DEALER down, then back off
+                # exponentially (cap 8x grace) — recreating every interval
+                # can perpetually abort a handshake slower than the
+                # interval and churns socket+monitor objects forever
+                attempts, next_at = self._recreate_state.get(
+                    name, (0, now + grace))
+                if now < next_at:
+                    if name not in self._recreate_state:
+                        self._recreate_state[name] = (attempts, next_at)
+                    continue
+                attempts = min(attempts + 1, 3)  # clamp the exponent too:
+                # a permanently-dead registry entry must not grow the
+                # counter (and the bignum 2**attempts) without bound
+                backoff = grace * (2 ** attempts)
+                self._recreate_state[name] = (attempts, now + backoff)
             ha = self._remote_ha.get(name)
             key = next((k for k, p in self._allowed.items() if p == name),
                        None)
@@ -378,6 +402,7 @@ class ZStack:
                     up = True
                     self._handshaken.add(peer)
                     self._down_since.pop(peer, None)
+                    self._recreate_state.pop(peer, None)
                 elif kind == zmq.EVENT_DISCONNECTED:
                     up = False
                     self._down_since.setdefault(peer, time.monotonic())

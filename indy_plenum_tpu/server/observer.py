@@ -38,7 +38,7 @@ class Observer:
                  name: str,
                  network,  # provides create_peer(name) -> ExternalBus
                  pool_bls_keys: Optional[Dict[str, str]] = None,
-                 weak_quorum: int = 1,
+                 weak_quorum: Optional[int] = None,
                  storage: Optional[NodeStorage] = None,
                  pool_genesis: Optional[list] = None,
                  domain_genesis: Optional[list] = None,
@@ -48,7 +48,10 @@ class Observer:
                  validators: Optional[list] = None):
         """``pool_bls_keys``: node name -> BLS pk b58 (trust anchor for
         single-push mode); ``weak_quorum``: f+1 of the pool, used when no
-        BLS keys are available. With ``timer`` + ``pool_size`` the
+        BLS keys are available — derived from ``pool_size`` /
+        ``validators`` when not given, so constructing an Observer with a
+        validator set never silently trusts a single push (round-4
+        advisor finding). With ``timer`` + ``pool_size`` the
         observer self-heals gaps: an observer registered mid-stream (or
         one that missed pushes) runs the ordinary catchup plane against
         the validators' seeders instead of stalling forever."""
@@ -57,13 +60,17 @@ class Observer:
             storage=storage, pool_genesis=pool_genesis,
             domain_genesis=domain_genesis).build()
         self._bls_keys = dict(pool_bls_keys or {})
-        self._weak_quorum = max(1, weak_quorum)
         # weak-quorum mode counts only VALIDATOR senders: without this,
         # f+1 arbitrary connected peers (other observers, clients) could
         # co-push fabricated batches whose self-consistent roots pass the
         # re-apply check. BLS keys double as the validator set.
         self._validators = set(validators) if validators is not None \
             else set(self._bls_keys) or None
+        if weak_quorum is None:
+            n = pool_size if pool_size is not None \
+                else len(self._validators or ())
+            weak_quorum = (n - 1) // 3 + 1 if n else 1
+        self._weak_quorum = max(1, weak_quorum)
         self.bus = network.create_peer(name)
         self.bus.subscribe(ObservedData, self.process_observed_data)
         self.last_applied_pp_seq_no = self.boot.committed_pp_seq_no
@@ -135,12 +142,22 @@ class Observer:
     def _content_key(self, data: ObservedData) -> str:
         import hashlib
 
-        from ..common.serializers.serialization import serialize_msg
+        from ..common.serializers.serialization import ledger_txn_serializer
 
         # the TXNS are part of the identity: a byzantine push with
         # genuine roots but fabricated txns must not merge with (and
-        # mask) honest pushes for the same batch
-        return hashlib.sha256(serialize_msg({
+        # mask) honest pushes for the same batch. Canonical (key-sorted)
+        # serialization: honest validators whose txn dicts were built in
+        # different insertion orders (live execution vs catchup rebuild)
+        # must still merge toward f+1 (round-4 advisor finding). The
+        # LEDGER's serializer, not the None-dropping signing one: content
+        # identity here must match what _apply hands to ledger.add, or a
+        # byzantine first push ({"a":1,"b":None}) could absorb honest
+        # senders ({"a":1}) into an entry whose txn root can't verify.
+        # Raises on non-JSON txns (mixed-type keys etc.) — the caller
+        # treats that as a rejected push, honest txns are JSON by
+        # construction (ledger storage is JSON).
+        return hashlib.sha256(ledger_txn_serializer.dumps({
             "l": data.ledgerId, "p": data.ppSeqNo,
             "s": data.stateRootHash, "t": data.txnRootHash,
             "x": list(data.txns),
@@ -159,8 +176,14 @@ class Observer:
             if data.ppSeqNo >= farthest:
                 return
             del self._stashed[farthest]
+        try:
+            key = self._content_key(data)
+        except Exception:  # noqa: BLE001 — pushed content is untrusted;
+            # a non-JSON-serializable txn (mixed-type dict keys survive
+            # msgpack) must reject the push, not crash the service loop
+            self.batches_rejected += 1
+            return
         slot = self._stashed.setdefault(data.ppSeqNo, {})
-        key = self._content_key(data)
         entry = slot.get(key)
         if entry is None:
             slot[key] = (data, {sender})

@@ -110,11 +110,22 @@ def bench_ed25519() -> dict:
 
 
 def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
-                   metric: str, note: str) -> dict:
+                   metric: str, note: str,
+                   host_accounting: bool = False) -> dict:
     """Ordered txns/sec with the device quorum plane as sole authority
     (no host shadow tallies), tick-batched flushes. ``num_instances`` > 1
     runs the full RBFT instance axis — backups' tallies ride the same
-    vmapped (node x instance) group dispatch as the masters'."""
+    vmapped (node x instance) group dispatch as the masters'.
+
+    ``host_accounting``: the sim runs ALL n validators' host loops
+    serially in one process, so raw wall-clock understates a deployed
+    pool by ~n. With accounting on, the bench ALSO measures (a) each
+    node's own CPU seconds (its message handling incl. triggered sends,
+    its per-instance tick evaluation, plus the FULL shared device flush
+    charged to every node — conservative) and (b) the protocol-time
+    throughput on the virtual clock. A deployed pool's capacity is
+    min(busiest-host bound, protocol pipeline bound) — that min becomes
+    the metric ``value``; the serial wall number is reported alongside."""
     from indy_plenum_tpu.config import getConfig
     from indy_plenum_tpu.simulation.pool import SimPool
 
@@ -128,7 +139,9 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
     })
     pool = SimPool(n_nodes=n_nodes, seed=11, config=config,
                    device_quorum=True, shadow_check=False,
-                   num_instances=num_instances)
+                   num_instances=num_instances,
+                   host_accounting=host_accounting,
+                   pipelined_flush=True)
 
     seq = 0
 
@@ -142,9 +155,11 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         return min(len(n.ordered_digests) for n in pool.nodes)
 
     def run_until(target, budget_s):
+        # 0.1 sim-sec steps: sim_elapsed (the protocol-time bound) must
+        # not be quantized by the driver loop's chunk size
         deadline = time.monotonic() + budget_s
         while min_ordered() < target and time.monotonic() < deadline:
-            pool.run_for(0.5)
+            pool.run_for(0.1)
         return min_ordered()
 
     # warm-up: compiles the vote-plane step for these shapes and fills
@@ -153,14 +168,20 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
     warm = run_until(batch_size, budget_s=240)
     assert warm >= batch_size, f"warm-up stalled at {warm}"
 
+    if host_accounting:
+        for name in pool.host_seconds:
+            pool.host_seconds[name] = 0.0  # exclude warm-up/compile time
     n_txns = batches * batch_size
     submit(n_txns)
+    sim_t0 = pool.timer.get_current_time()
     t0 = time.perf_counter()
     got = run_until(batch_size + n_txns, budget_s=300)
     elapsed = time.perf_counter() - t0
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
     ordered = got - batch_size
     assert pool.honest_nodes_agree()
-    value = ordered / elapsed
+    serial_tps = ordered / elapsed
+    value = serial_tps
     out = {
         "metric": metric,
         "value": round(value, 1),
@@ -174,6 +195,32 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "wall_s": round(elapsed, 2),
         "device_flushes": pool.vote_group.flushes,
     }
+    if host_accounting:
+        busiest = max(pool.host_seconds.values())
+        per_host_tps = ordered / busiest if busiest > 0 else 0.0
+        sim_tps = ordered / sim_elapsed if sim_elapsed > 0 else 0.0
+        value = min(per_host_tps, sim_tps)
+        out.update({
+            "value": round(value, 1),
+            "vs_baseline": round(
+                value / ESTIMATED_REFERENCE_ORDERED_TXNS_PER_SEC_N64, 3),
+            "serial_wall_txns_per_sec": round(serial_tps, 1),
+            "per_host_cpu_bound_txns_per_sec": round(per_host_tps, 1),
+            "protocol_time_txns_per_sec": round(sim_tps, 1),
+            "busiest_host_cpu_s": round(busiest, 3),
+            "sim_elapsed_s": round(sim_elapsed, 3),
+            "accounting_note":
+                "value = min(per-host CPU bound, protocol pipeline bound)."
+                " The sim runs all %d hosts serially in ONE process"
+                " (serial_wall is that raw number); per-host accounting"
+                " charges each node its own message handling (incl. sends"
+                " it triggers), its per-instance tick evaluation, and the"
+                " FULL shared device flush (conservative: a deployed node"
+                " flushes only its own %d-member plane). Excluded: the"
+                " simulated network's timer-heap bookkeeping (a deployed"
+                " node's transport loop is the zmq stack instead)."
+                % (n_nodes, num_instances),
+        })
     if num_instances > 1:
         out["backups_ordered_upto"] = min(
             b.data.last_ordered_3pc[1]
@@ -196,14 +243,13 @@ def bench_ordered_txns_n64_rbft() -> dict:
     n = 64
     f_plus_1 = (n - 1) // 3 + 1
     return _bench_ordered(
-        n, f_plus_1, batches=3,
+        n, f_plus_1, batches=6,
         metric="ordered_txns_per_sec_n64_rbft_full_instances",
         note="full RBFT: f+1=%d parallel instances; vs the same 100 "
              "txns/sec CPU estimate (reference also pays the instance "
-             "multiplier). NB: the simulation runs ALL %d validators' "
-             "host loops serially in one Python process — a deployed "
-             "pool runs one loop per host, so per-node load here is %dx "
-             "a real validator's" % (f_plus_1, n, n))
+             "multiplier). See accounting_note for the capacity model "
+             "behind value" % f_plus_1,
+        host_accounting=True)
 
 
 def bench_ordered_txns_n100() -> dict:
@@ -212,7 +258,8 @@ def bench_ordered_txns_n100() -> dict:
         metric="ordered_txns_per_sec_n100_device_quorum",
         note="n=100 with tick-batched device quorum; vs the same 100 "
              "txns/sec CPU estimate (folklore is for <=64 nodes; at "
-             "n=100 the reference's O(n^2) host tallies only get worse)")
+             "n=100 the reference's O(n^2) host tallies only get worse)",
+        host_accounting=True)
 
 
 def bench_catchup_proofs() -> dict:

@@ -295,9 +295,12 @@ class Client:
 
     def take_result(self, digest: str) -> Optional[dict]:
         """``result()`` + retire: the long-running-client shape. Returns
-        None (and retires nothing) while the quorum is still pending."""
+        None while the quorum is still pending (nothing retired) AND for
+        a rejected request — which IS retired, so NACKed requests don't
+        accumulate and their (identifier, reqId) slot frees up; check
+        ``is_rejected`` before calling when the distinction matters."""
         res = self.result(digest)
-        if res is not None:
+        if res is not None or self.is_rejected(digest):
             self.retire(digest)
         return res
 

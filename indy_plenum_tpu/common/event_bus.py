@@ -12,31 +12,51 @@ from collections import defaultdict
 from typing import Any, Callable, NamedTuple
 
 
-class InternalBus:
-    """Synchronous typed pub/sub: subscribers keyed by message class."""
+class _DispatchCache:
+    """MRO-walk + duplicate-handler dedupe, memoized per concrete type.
+
+    Both buses deliver to every handler subscribed at any level of the
+    message's MRO, each at most once per send. Doing that walk (and the
+    O(handlers^2) bound-method equality dedupe) on EVERY delivery is the
+    hottest line of a dense pool simulation; the subscription set changes
+    rarely, so the flattened handler tuple is computed once per concrete
+    type and invalidated on subscribe/unsubscribe.
+    """
 
     def __init__(self):
         self._handlers: dict[type, list[Callable]] = defaultdict(list)
+        self._cache: dict[type, tuple] = {}
 
     def subscribe(self, message_type: type, handler: Callable) -> None:
         self._handlers[message_type].append(handler)
+        self._cache.clear()
 
     def unsubscribe(self, message_type: type, handler: Callable) -> None:
         if handler in self._handlers.get(message_type, []):
             self._handlers[message_type].remove(handler)
+            self._cache.clear()
+
+    def handlers_for(self, cls: type) -> tuple:
+        cached = self._cache.get(cls)
+        if cached is None:
+            seen = []
+            for base in cls.__mro__:
+                for handler in self._handlers.get(base, ()):
+                    if handler not in seen:  # == dedupes bound methods too
+                        seen.append(handler)
+            cached = self._cache[cls] = tuple(seen)
+        return cached
+
+
+class InternalBus(_DispatchCache):
+    """Synchronous typed pub/sub: subscribers keyed by message class."""
 
     def send(self, message: Any, *args) -> None:
-        # Walk the MRO so handlers may subscribe to base classes; a handler
-        # subscribed at several levels still fires at most once per send.
-        seen = []
-        for cls in type(message).__mro__:
-            for handler in tuple(self._handlers.get(cls, ())):
-                if handler not in seen:  # == dedupes equal bound methods too
-                    seen.append(handler)
-                    handler(message, *args)
+        for handler in self.handlers_for(type(message)):
+            handler(message, *args)
 
 
-class ExternalBus:
+class ExternalBus(_DispatchCache):
     """Network abstraction handed to consensus services.
 
     ``send_handler(msg, dst)`` with dst=None means broadcast to all
@@ -52,31 +72,24 @@ class ExternalBus:
         name: str
 
     def __init__(self, send_handler: Callable[[Any, str | None], None]):
+        super().__init__()
         self._send_handler = send_handler
-        self._handlers: dict[type, list[Callable]] = defaultdict(list)
         self._connecteds: set[str] = set()
 
     @property
     def connecteds(self) -> set[str]:
         return set(self._connecteds)
 
-    def subscribe(self, message_type: type, handler: Callable) -> None:
-        self._handlers[message_type].append(handler)
-
-    def unsubscribe(self, message_type: type, handler: Callable) -> None:
-        if handler in self._handlers.get(message_type, []):
-            self._handlers[message_type].remove(handler)
+    def is_connected(self, name: str) -> bool:
+        """O(1) membership, no defensive copy (the per-delivery check)."""
+        return name in self._connecteds
 
     def send(self, message: Any, dst: str | list[str] | None = None) -> None:
         self._send_handler(message, dst)
 
     def process_incoming(self, message: Any, frm: str) -> None:
-        seen = []
-        for cls in type(message).__mro__:
-            for handler in tuple(self._handlers.get(cls, ())):
-                if handler not in seen:  # == dedupes equal bound methods too
-                    seen.append(handler)
-                    handler(message, frm)
+        for handler in self.handlers_for(type(message)):
+            handler(message, frm)
 
     def update_connecteds(self, connecteds: set[str]) -> None:
         added = connecteds - self._connecteds

@@ -154,6 +154,17 @@ class Node:
         self.external_bus = network.create_peer(name)
         self.stasher = StashingRouter(
             limit=1000, buses=[self.internal_bus, self.external_bus])
+        # 3PC traffic is demuxed by instId BEFORE any router runs: the
+        # master's per-instance services get their own router (registered
+        # as instance 0) and backups register theirs, so an inbound
+        # PREPARE costs one dict hop + one router pass, not one pass per
+        # live instance (reference: Node.sendToReplica)
+        from .instance_demux import Instance3PCDemux
+
+        self.demux = Instance3PCDemux(self.external_bus)
+        self.stasher3pc = StashingRouter(
+            limit=1000, buses=[self.internal_bus])
+        self.demux.register(0, self.stasher3pc)
 
         # --- persistence + execution -----------------------------------
         self.boot = LedgersBootstrap(
@@ -234,13 +245,13 @@ class Node:
         # --- consensus services -----------------------------------------
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
-            network=self.external_bus, stasher=self.stasher,
+            network=self.external_bus, stasher=self.stasher3pc,
             executor=self.executor, requests=self.requests_pool,
             config=self.config, vote_plane=vote_plane,
             bls=self.bls_replica)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
-            network=self.external_bus, stasher=self.stasher,
+            network=self.external_bus, stasher=self.stasher3pc,
             config=self.config, vote_plane=vote_plane)
         self.view_changer = ViewChangeService(
             data=self.data, timer=timer, bus=self.internal_bus,
@@ -311,7 +322,8 @@ class Node:
             on_backup_ordered=self._on_backup_ordered,
             forward_request_propagates=self._on_request_propagates,
             num_instances=num_instances,
-            vote_plane_factory=backup_vote_plane_factory)
+            vote_plane_factory=backup_vote_plane_factory,
+            demux=self.demux)
         if num_instances > 1:
             self.replicas.build(0, self.data.primaries)
         self.internal_bus.subscribe(ViewChangeStarted,

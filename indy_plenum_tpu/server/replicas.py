@@ -56,7 +56,8 @@ class BackupReplica:
                  requests_pool,
                  on_ordered: Callable[[Ordered], None],
                  forward_request_propagates: Optional[Callable] = None,
-                 vote_plane=None):
+                 vote_plane=None,
+                 demux=None):
         self.inst_id = inst_id
         self.data = ConsensusSharedData(
             node_name, validators, inst_id=inst_id, is_master=False,
@@ -64,8 +65,17 @@ class BackupReplica:
         self.data.view_no = view_no
         self.data.primaries = list(primaries)
         self.internal_bus = InternalBus()
-        self.stasher = StashingRouter(
-            limit=1000, buses=[self.internal_bus, external_bus])
+        # with a demux (Instance3PCDemux), inbound 3PC traffic reaches
+        # THIS instance's router via one O(1) instId hop instead of every
+        # instance running its router over every message — without it
+        # (demux=None, the pre-round-5 shape) the stasher subscribes the
+        # shared external bus directly
+        self._demux = demux
+        buses = [self.internal_bus] if demux is not None \
+            else [self.internal_bus, external_bus]
+        self.stasher = StashingRouter(limit=1000, buses=buses)
+        if demux is not None:
+            demux.register(inst_id, self.stasher)
         self.requests_pool = requests_pool
         self.vote_plane = vote_plane
         self.ordering = OrderingService(
@@ -92,6 +102,8 @@ class BackupReplica:
 
     def stop(self) -> None:
         self.ordering.stop()
+        if self._demux is not None:
+            self._demux.unregister(self.inst_id)
         self.stasher.unsubscribe_all()
 
 
@@ -108,7 +120,8 @@ class Replicas:
                  on_backup_ordered: Callable[[int, Ordered], None],
                  forward_request_propagates: Optional[Callable] = None,
                  num_instances: Optional[int] = None,
-                 vote_plane_factory: Optional[Callable] = None):
+                 vote_plane_factory: Optional[Callable] = None,
+                 demux=None):
         self._node_name = node_name
         # a list, or a zero-arg provider of the CURRENT validator set —
         # rebuilt backups must see live membership, not the boot-time list
@@ -124,6 +137,7 @@ class Replicas:
         # vmapped (node x instance) group dispatch as the master's (SURVEY
         # §2.6's TPU mapping: instances = leading axis on the vote tensors)
         self._vote_plane_factory = vote_plane_factory
+        self._demux = demux
         # instance count the NODE was sized for (monitor slots, primaries
         # list length) — not re-derived here, or the two could disagree
         self._num_instances = (
@@ -152,7 +166,8 @@ class Replicas:
                 requests_pool=self._make_requests_pool(),
                 on_ordered=lambda o, i=inst_id: self._on_backup_ordered(i, o),
                 forward_request_propagates=self._forward_request_propagates,
-                vote_plane=plane)
+                vote_plane=plane,
+                demux=self._demux)
             replica.start()
             self.backups.append(replica)
         logger.debug("%s built %d backup instance(s) for view %d",

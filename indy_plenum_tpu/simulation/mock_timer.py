@@ -17,11 +17,11 @@ class MockTimer(QueueTimer):
 
     def set_time(self, value: float) -> None:
         """Jump the clock forward, firing everything due on the way."""
-        while True:
-            nxt = self.next_event_time()
-            if nxt is None or nxt > value:
-                break
-            self._now = nxt
+        events = self._events  # peek the heap directly: one pass per due
+        # event, not a next_event_time() + service() pair per timestamp
+        # (cancelled heads are popped unfired by service() itself)
+        while events and events[0].timestamp <= value:
+            self._now = events[0].timestamp
             self.service()
         self._now = value
         self.service()

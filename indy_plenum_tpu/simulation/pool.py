@@ -171,6 +171,15 @@ class SimNode:
         self.external_bus = network.create_peer(name)
         self.stasher = StashingRouter(
             limit=1000, buses=[self.internal_bus, self.external_bus])
+        # instId demux (same wiring as the production Node): per-instance
+        # 3PC traffic takes one dict hop to ONE router — k instances must
+        # not each run their router over every inbound message
+        from ..server.instance_demux import Instance3PCDemux
+
+        self.demux = Instance3PCDemux(self.external_bus)
+        self.stasher3pc = StashingRouter(
+            limit=1000, buses=[self.internal_bus])
+        self.demux.register(0, self.stasher3pc)
         self.boot = None
         if domain_genesis is not None:
             # real execution: ledgers + SMT states + audit spine per node
@@ -227,13 +236,14 @@ class SimNode:
 
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,
-            network=self.external_bus, stasher=self.stasher,
+            network=self.external_bus, stasher=self.stasher3pc,
             executor=self.executor, requests=self.requests_view,
             config=config, vote_plane=self.vote_plane,
             shadow_check=shadow_check, bls=self.bls_replica)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
-            network=self.external_bus, stasher=self.stasher, config=config,
+            network=self.external_bus, stasher=self.stasher3pc,
+            config=config,
             vote_plane=self.vote_plane, shadow_check=shadow_check)
         self.view_changer = ViewChangeService(
             data=self.data, timer=timer, bus=self.internal_bus,
@@ -333,7 +343,9 @@ class SimPool:
                  bls: bool = False,
                  shadow_check: Optional[bool] = None,
                  num_instances: int = 1,
-                 mesh=None):
+                 mesh=None,
+                 host_accounting: bool = False,
+                 pipelined_flush: bool = False):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -389,7 +401,8 @@ class SimPool:
         if device_quorum:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
-                num_instances=num_instances, mesh=mesh)
+                num_instances=num_instances, mesh=mesh,
+                pipelined=pipelined_flush)
 
         k = num_instances
         self.nodes: List[SimNode] = [
@@ -429,17 +442,55 @@ class SimPool:
                         requests_pool=self.requests.view_for(
                             f"{node.name}#{inst}"),
                         on_ordered=lambda o: None,
-                        vote_plane=plane)
+                        vote_plane=plane,
+                        demux=node.demux)
                     replica.start()
                     backups.append(replica)
                 # the shape quorum_driver's tick expects (Node.replicas)
                 node.replicas = types.SimpleNamespace(backups=backups)
 
+        # per-host CPU accounting: the simulation runs all n validators'
+        # host loops serially in ONE process, so wall-clock understates a
+        # deployed pool by ~n. With accounting on, each node's OWN work
+        # (its inbound message handling including the sends it triggers,
+        # its per-instance tick evaluation, and the FULL shared device
+        # flush — conservative: a real node flushes only its own
+        # num_instances-member plane) accumulates in host_seconds[name];
+        # the busiest node bounds a deployed pool's throughput.
+        self.host_seconds: Optional[Dict[str, float]] = None
+        if host_accounting:
+            self.host_seconds = {n.name: 0.0 for n in self.nodes}
+            for nd in self.nodes:
+                self._install_accounting(nd)
+
         # tick-batched quorum mode: ONE group flush per tick serves the
         # whole pool; services evaluate against that snapshot and votes
         # recorded during the wave buffer for the next tick
         self._quorum_tick_timer = drive_group_ticks(
-            self.timer, self.config, self.vote_group, self.nodes)
+            self.timer, self.config, self.vote_group, self.nodes,
+            accounting=self.host_seconds)
+
+    def _install_accounting(self, node: "SimNode") -> None:
+        import time as _time
+
+        bus = node.external_bus
+        inner = bus.process_incoming
+        acct = self.host_seconds
+        name = node.name
+        inflight = [False]  # MessageRep re-injection nests process_incoming
+
+        def timed(msg, frm):
+            if inflight[0]:
+                return inner(msg, frm)
+            inflight[0] = True
+            t0 = _time.perf_counter()
+            try:
+                return inner(msg, frm)
+            finally:
+                inflight[0] = False
+                acct[name] += _time.perf_counter() - t0
+
+        bus.process_incoming = timed
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)

@@ -15,38 +15,48 @@ from ..config import Config
 
 
 def make_vote_group(n_nodes: int, validators, config: Config,
-                    num_instances: int = 1, mesh=None):
+                    num_instances: int = 1, mesh=None,
+                    pipelined: bool = False):
     """Member axis = (node x instance): member i*num_instances + inst_id
     is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
     mapping — instances are a leading tensor dimension, so backups' vote
     tallies ride the same vmapped dispatch as the master's). ``mesh``
-    shards that member axis across a device mesh (SPMD group step)."""
+    shards that member axis across a device mesh (SPMD group step);
+    ``pipelined`` overlaps each tick's device round-trip with the next
+    tick's host work (verdicts lag one tick)."""
     from ..tpu.vote_plane import VotePlaneGroup
 
     return VotePlaneGroup(
         n_nodes * max(1, num_instances), list(validators),
         log_size=config.LOG_SIZE,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
-        mesh=mesh)
+        mesh=mesh, pipelined=pipelined)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
-                      nodes) -> Optional[RepeatingTimer]:
+                      nodes, accounting=None) -> Optional[RepeatingTimer]:
     """Start the pool-level quorum tick (tick-batched mode only).
 
     Each node must expose ``vote_plane`` / ``ordering`` / ``checkpoints``;
     queries between ticks read the per-tick snapshot
     (``defer_flush_on_query``), and ONE group flush per tick serves the
-    whole pool.
+    whole pool. ``accounting`` (name -> seconds) attributes each node's
+    tick evaluation to it, plus the FULL shared flush time to EVERY node
+    (conservative: a deployed node flushes only its own plane).
     """
     if vote_group is None or config.QuorumTickInterval <= 0:
         return None
     for node in nodes:
         node.vote_plane.defer_flush_on_query = True
 
+    from time import perf_counter
+
     def tick() -> None:
+        t0 = perf_counter() if accounting is not None else 0.0
         vote_group.flush()
+        flush_dt = perf_counter() - t0 if accounting is not None else 0.0
         for node in nodes:
+            t0 = perf_counter() if accounting is not None else 0.0
             node.ordering.service_quorum_tick()
             node.checkpoints.service_quorum_tick()
             replicas = getattr(node, "replicas", None)  # SimNode has none
@@ -54,5 +64,7 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                 if backup.vote_plane is not None:
                     backup.ordering.service_quorum_tick()
                     backup.checkpoints.service_quorum_tick()
+            if accounting is not None:
+                accounting[node.name] += (perf_counter() - t0) + flush_dt
 
     return RepeatingTimer(timer, config.QuorumTickInterval, tick)

@@ -99,7 +99,7 @@ class SimNetwork:
         if to not in self._peers:
             return
         # link must be up (receiver sees sender as connected)
-        if frm not in self._peers[to].connecteds:
+        if not self._peers[to].is_connected(frm):
             self.dropped += 1
             return
         latency = self._rng.uniform(self._min_latency, self._max_latency)

@@ -273,8 +273,16 @@ def _pack_group_messages(chunks: List[List[tuple]], max_batch: int
     slot = np.zeros((m, max_batch), np.int32)
     valid = np.zeros((m, max_batch), bool)
     for j, entries in enumerate(chunks):
-        for i, (k, s, sl) in enumerate(entries):
-            kind[j, i], sender[j, i], slot[j, i], valid[j, i] = k, s, sl, True
+        if not entries:
+            continue
+        # one vectorized row write per member, not a Python loop per vote
+        # (a dense-pool tick flushes tens of thousands of votes)
+        arr = np.asarray(entries, np.int32)
+        k = arr.shape[0]
+        kind[j, :k] = arr[:, 0]
+        sender[j, :k] = arr[:, 1]
+        slot[j, :k] = arr[:, 2]
+        valid[j, :k] = True
     return q.MsgBatch(kind=jnp.asarray(kind), sender=jnp.asarray(sender),
                       slot=jnp.asarray(slot), valid=jnp.asarray(valid))
 
@@ -292,7 +300,7 @@ class VotePlaneGroup:
 
     def __init__(self, n_members: int, validators: List[str], log_size: int,
                  n_checkpoints: int = 4, h: int = 0, metrics=None,
-                 mesh=None):
+                 mesh=None, pipelined: bool = False):
         """``mesh``: an optional :class:`jax.sharding.Mesh` with one axis;
         the member axis of every vote tensor is sharded across it, so one
         pod's chips split the pool's planes and the vmapped group step
@@ -334,6 +342,14 @@ class VotePlaneGroup:
         # latency and votes-per-flush land here (injectable for a shared
         # or null collector)
         self.metrics = metrics if metrics is not None else MetricsCollector()
+        # pipelined mode: flush() DISPATCHES this tick's step (async, JAX
+        # never blocks on dispatch) and absorbs the PREVIOUS tick's events
+        # into the host snapshot — the device round-trip overlaps a full
+        # tick of host work instead of stalling the loop. Cost: quorum
+        # verdicts lag one extra tick (votes are never lost; the services'
+        # lost-wakeup guard re-arms while a step is in flight).
+        self.pipelined = pipelined
+        self._inflight: Optional[q.QuorumEvents] = None
 
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
@@ -347,8 +363,62 @@ class VotePlaneGroup:
         return jax.tree.map(
             lambda x: jax.device_put(x, self._sharding(x.ndim)), msgs)
 
+    def _absorb(self, events: q.QuorumEvents) -> None:
+        """ONE bundled device->host transfer into the host snapshot."""
+        (self._host_prepared, self._host_prepare_counts,
+         self._host_commit_counts, self._host_stable) = jax.device_get(
+            (events.prepared, events.prepare_counts,
+             events.commit_counts, events.stable_checkpoints))
+        self.version += 1
+
+    @property
+    def lagging(self) -> bool:
+        """True while a dispatched step's events are not yet in the host
+        snapshot (pipelined mode) — quorum state may be newer on device."""
+        return self._inflight is not None
+
+    def _flush_pipelined(self) -> None:
+        # 1. absorb the step dispatched LAST tick (usually complete by
+        # now: the whole tick's host work overlapped its round-trip)
+        if self._inflight is not None:
+            events, self._inflight = self._inflight, None
+            self._absorb(events)
+        # 2. dispatch this tick's votes; events ride to the host next tick
+        events = None
+        while any(m._pending for m in self._members):
+            chunks = []
+            votes = 0
+            for m in self._members:
+                take, m._pending = (m._pending[:FLUSH_BATCH],
+                                    m._pending[FLUSH_BATCH:])
+                chunks.append(take)
+                votes += len(take)
+            msgs = self._place(_pack_group_messages(chunks, FLUSH_BATCH))
+            self._states, events = _group_step(self._states, msgs, self._n)
+            self.flushes += 1
+            self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+            self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
+        if events is not None:
+            # the LAST chained step's events reflect every vote above
+            self._inflight = events
+        if self._host_prepared is None:
+            # cold start (or post-slide/reset): callers need SOME snapshot
+            if self._inflight is None:
+                msgs = self._place(_pack_group_messages(
+                    [[] for _ in self._members], FLUSH_BATCH))
+                self._states, self._inflight = _group_step(
+                    self._states, msgs, self._n)
+                self.flushes += 1
+                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+            events, self._inflight = self._inflight, None
+            self._absorb(events)
+
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
+        if self.pipelined:
+            with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
+                self._flush_pipelined()
+            return
         if (not any(m._pending for m in self._members)
                 and self._host_prepared is not None):
             return
@@ -379,14 +449,18 @@ class VotePlaneGroup:
                 self.metrics.add_event(MetricsName.DEVICE_FLUSH)
             # ONE bundled device->host transfer (separate np.asarray calls
             # cost one link round-trip each — painful on a remote device)
-            (self._host_prepared, self._host_prepare_counts,
-             self._host_commit_counts, self._host_stable) = jax.device_get(
-                (events.prepared, events.prepare_counts,
-                 events.commit_counts, events.stable_checkpoints))
-            self.version += 1
+            self._absorb(events)
+
+    def _sync_inflight(self) -> None:
+        """Absorb any in-flight step NOW (window/view operations must not
+        run with stale events pending under the OLD slot mapping)."""
+        if self._inflight is not None:
+            events, self._inflight = self._inflight, None
+            self._absorb(events)
 
     def slide_member(self, member_idx: int, delta: int) -> None:
         self.flush()
+        self._sync_inflight()
         deltas = np.zeros(len(self._members), np.int32)
         deltas[member_idx] = delta
         deltas = jnp.asarray(deltas)
@@ -399,6 +473,7 @@ class VotePlaneGroup:
     def reset_member(self, member_idx: int) -> None:
         # pending for this member was cleared by the caller; other members'
         # buffered votes are untouched (flushed on their next query)
+        self._sync_inflight()  # old-view events must not land post-reset
         self._states = _group_zero_member(
             self._states, jnp.int32(member_idx))
         self.version += 1
@@ -437,6 +512,13 @@ class _MemberPlane(DeviceVotePlane):
     def flushes(self, value) -> None:  # base-class compat; group owns it
         pass
 
+    @property
+    def has_buffered_votes(self) -> bool:
+        # pipelined group: votes dispatched but not yet in the snapshot
+        # must keep the services' lost-wakeup guard armed, exactly like
+        # host-buffered votes
+        return bool(self._pending) or self._group.lagging
+
     def _flush(self) -> None:
         self._group.flush()
 
@@ -450,6 +532,11 @@ class _MemberPlane(DeviceVotePlane):
 
     def _refresh(self) -> None:
         self._group.flush()
+        if not self.defer_flush_on_query:
+            # per-query mode wants CURRENT state: a pipelined group must
+            # absorb its in-flight step now, or the final batch's votes
+            # sit on-device forever with no tick driver to absorb them
+            self._group._sync_inflight()
         self._copy_slices()
 
     def events(self):

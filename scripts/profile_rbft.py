@@ -1,0 +1,68 @@
+"""Profile the full-RBFT sim loop on CPU: where do 22 instances spend it?
+
+Usage: python scripts/profile_rbft.py [n_nodes] [instances] [txns]
+"""
+import cProfile
+import pstats
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    txns = int(sys.argv[3]) if len(sys.argv) > 3 else 320
+    batch = 160
+    config = getConfig({
+        "Max3PCBatchSize": batch,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": 0.1,
+    })
+    pool = SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
+                   shadow_check=False, num_instances=k)
+    seq = 0
+
+    def submit(count):
+        nonlocal seq
+        for _ in range(count):
+            seq += 1
+            pool.submit_request(seq)
+
+    def min_ordered():
+        return min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    # warm-up
+    submit(batch)
+    deadline = time.monotonic() + 240
+    while min_ordered() < batch and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert min_ordered() >= batch, "warm-up stalled"
+
+    submit(txns)
+    target = batch + txns
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    while min_ordered() < target and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    prof.disable()
+    elapsed = time.perf_counter() - t0
+    got = min_ordered() - batch
+    print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
+          f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
+    stats = pstats.Stats(prof, stream=sys.stderr)
+    stats.sort_stats("cumulative").print_stats(35)
+    stats.sort_stats("tottime").print_stats(35)
+
+
+if __name__ == "__main__":
+    main()

@@ -294,13 +294,14 @@ class Client:
         return state.result if state else None
 
     def take_result(self, digest: str) -> Optional[dict]:
-        """``result()`` + retire: the long-running-client shape. Returns
-        None while the quorum is still pending (nothing retired) AND for
-        a rejected request — which IS retired, so NACKed requests don't
-        accumulate and their (identifier, reqId) slot frees up; check
-        ``is_rejected`` before calling when the distinction matters."""
+        """``result()`` + retire: the long-running-client happy path.
+        Returns None without retiring while the quorum is pending OR the
+        request was rejected — rejection evidence stays queryable via
+        ``is_rejected``/``pending[digest].nacks``; call ``retire()``
+        after inspecting it (rejected requests are the caller's to free,
+        or they accumulate like any unconsumed result)."""
         res = self.result(digest)
-        if res is not None or self.is_rejected(digest):
+        if res is not None:
             self.retire(digest)
         return res
 

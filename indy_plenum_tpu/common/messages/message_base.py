@@ -47,13 +47,15 @@ class MessageBase:
             err = validator.validate(val)
             if err:
                 raise InvalidMessageError(f"{self.typename}.{name}: {err}")
-        object.__setattr__(self, "_values", values)
-        # mirror fields into the instance __dict__ (subclasses declare no
+        # fields live in the instance __dict__ (subclasses declare no
         # __slots__, so one exists): attribute reads become native lookups
         # instead of __getattr__ -> dict fetch — 3PC handlers read several
         # fields per message and this is measurably the hottest attribute
-        # path in a dense pool. __setattr__ still blocks mutation.
+        # path in a dense pool. _values ALIASES that dict (not a copy:
+        # thousands of stashed messages must not pay double storage).
+        # __setattr__ still blocks mutation.
         self.__dict__.update(values)
+        object.__setattr__(self, "_values", self.__dict__)
 
     def __setattr__(self, key, value):
         raise AttributeError(f"{type(self).__name__} is immutable")

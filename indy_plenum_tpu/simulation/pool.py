@@ -473,24 +473,34 @@ class SimPool:
     def _install_accounting(self, node: "SimNode") -> None:
         import time as _time
 
-        bus = node.external_bus
-        inner = bus.process_incoming
         acct = self.host_seconds
         name = node.name
         inflight = [False]  # MessageRep re-injection nests process_incoming
 
-        def timed(msg, frm):
-            if inflight[0]:
-                return inner(msg, frm)
-            inflight[0] = True
-            t0 = _time.perf_counter()
-            try:
-                return inner(msg, frm)
-            finally:
-                inflight[0] = False
-                acct[name] += _time.perf_counter() - t0
+        def timed_call(inner):
+            def wrapper(*args, **kwargs):
+                if inflight[0]:
+                    return inner(*args, **kwargs)
+                inflight[0] = True
+                t0 = _time.perf_counter()
+                try:
+                    return inner(*args, **kwargs)
+                finally:
+                    inflight[0] = False
+                    acct[name] += _time.perf_counter() - t0
+            return wrapper
 
-        bus.process_incoming = timed
+        bus = node.external_bus
+        bus.process_incoming = timed_call(bus.process_incoming)
+        # timer-driven work is real host cost too: the primary's batch
+        # build + PRE-PREPARE broadcast runs off the batch timer, not off
+        # any inbound message (_on_batch_timer resolves send_3pc_batch on
+        # self at CALL time, so instance-attribute wrapping takes effect)
+        node.ordering.send_3pc_batch = timed_call(node.ordering.send_3pc_batch)
+        replicas = getattr(node, "replicas", None)
+        for backup in (replicas.backups if replicas else ()):
+            backup.ordering.send_3pc_batch = timed_call(
+                backup.ordering.send_3pc_batch)
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)

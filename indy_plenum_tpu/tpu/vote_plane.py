@@ -46,6 +46,18 @@ def _step(state: q.VoteState, msgs: q.MsgBatch, n_validators: int):
     return q.step(state, msgs, n_validators)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _step_words(state: q.VoteState, words, n_validators: int):
+    return q.step(state, q.unpack_words(words), n_validators)
+
+
+def _words_row(entries, max_batch: int) -> np.ndarray:
+    """(already-packed uint32 vote ints) -> padded (max_batch,) row."""
+    out = np.zeros(max_batch, np.uint32)
+    out[: len(entries)] = np.fromiter(entries, np.uint32, len(entries))
+    return out
+
+
 def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
     """Roll the slot axis left by ``delta`` and zero the vacated columns."""
     s = state.prepare_votes.shape[1]
@@ -79,6 +91,15 @@ def _group_step(states: q.VoteState, msgs: q.MsgBatch, n_validators: int):
     return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _group_step_words(states: q.VoteState, words, n_validators: int):
+    """Group step over word-packed votes: the (M, B) uint32 operand is a
+    quarter the bytes of a MsgBatch — the host->device transfer is the
+    blocking cost of a flush, so this is the wire format for groups."""
+    msgs = q.unpack_words(words)
+    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+
+
 @jax.jit
 def _group_slide(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
     return jax.vmap(_slide_core)(states, deltas)
@@ -101,7 +122,7 @@ class DeviceVotePlane:
         self._n_chk = n_checkpoints
         self._h = h
         self._state = q.init_state(self._n, log_size, n_checkpoints)
-        self._pending: List[tuple] = []  # (kind, sender_idx, slot)
+        self._pending: List[int] = []  # uint32 vote words (q.pack_vote)
         self._events: Optional[q.QuorumEvents] = None
         # host copies of the event arrays, refreshed once per flush (quorum
         # queries are per-message; don't re-transfer per query)
@@ -143,7 +164,7 @@ class DeviceVotePlane:
         idx = 0 if sender is None else self._index.get(sender)
         if idx is None:
             return
-        self._pending.append((kind, idx, slot))
+        self._pending.append(q.pack_vote(kind, idx, slot))
         self._events = None
 
     def record_preprepare(self, pp_seq_no: int) -> None:
@@ -157,7 +178,8 @@ class DeviceVotePlane:
 
     def record_checkpoint(self, sender: str, chk_slot: int) -> None:
         if 0 <= chk_slot < self._n_chk and sender in self._index:
-            self._pending.append((q.CHECKPOINT, self._index[sender], chk_slot))
+            self._pending.append(
+                q.pack_vote(q.CHECKPOINT, self._index[sender], chk_slot))
             self._events = None
 
     def checkpoint_slot(self, seq_no_end: int, chk_freq: int) -> Optional[int]:
@@ -214,15 +236,17 @@ class DeviceVotePlane:
         while self._pending:
             chunk, self._pending = (self._pending[:FLUSH_BATCH],
                                     self._pending[FLUSH_BATCH:])
-            msgs = q.pack_messages(chunk, FLUSH_BATCH)
-            self._state, self._events = _step(self._state, msgs, self._n)
+            words = jnp.asarray(_words_row(chunk, FLUSH_BATCH))
+            self._state, self._events = _step_words(
+                self._state, words, self._n)
             self.flushes += 1
 
     def _refresh(self) -> None:
         self._flush()
         if self._events is None:  # nothing ever recorded
-            self._state, self._events = _step(
-                self._state, q.pack_messages([], FLUSH_BATCH), self._n)
+            self._state, self._events = _step_words(
+                self._state, jnp.asarray(_words_row([], FLUSH_BATCH)),
+                self._n)
         (self._host_prepared, self._host_prepare_counts,
          self._host_commit_counts, self._host_stable) = jax.device_get(
             (self._events.prepared, self._events.prepare_counts,
@@ -264,27 +288,22 @@ class DeviceVotePlane:
         return int(self._host_prepare_counts[slot])
 
 
-def _pack_group_messages(chunks: List[List[tuple]], max_batch: int
-                         ) -> q.MsgBatch:
-    """(M lists of (kind, sender, slot)) -> one stacked (M, B) MsgBatch."""
+def _pack_group_words(chunks: List[List[int]], max_batch: int
+                      ) -> jnp.ndarray:
+    """(M lists of pre-packed vote words) -> one (M, B) uint32 array.
+
+    One vectorized row write per member (a dense-pool tick flushes tens
+    of thousands of votes) and one word per vote on the wire — the
+    host->device transfer is the blocking cost of a flush."""
     m = len(chunks)
-    kind = np.zeros((m, max_batch), np.int32)
-    sender = np.zeros((m, max_batch), np.int32)
-    slot = np.zeros((m, max_batch), np.int32)
-    valid = np.zeros((m, max_batch), bool)
+    words = np.zeros((m, max_batch), np.uint32)
     for j, entries in enumerate(chunks):
-        if not entries:
-            continue
-        # one vectorized row write per member, not a Python loop per vote
-        # (a dense-pool tick flushes tens of thousands of votes)
-        arr = np.asarray(entries, np.int32)
-        k = arr.shape[0]
-        kind[j, :k] = arr[:, 0]
-        sender[j, :k] = arr[:, 1]
-        slot[j, :k] = arr[:, 2]
-        valid[j, :k] = True
-    return q.MsgBatch(kind=jnp.asarray(kind), sender=jnp.asarray(sender),
-                      slot=jnp.asarray(slot), valid=jnp.asarray(valid))
+        if entries:
+            # entries are pre-packed words (q.pack_vote at record time):
+            # one fromiter per member, no tuple-list conversion
+            words[j, :len(entries)] = np.fromiter(
+                entries, np.uint32, len(entries))
+    return jnp.asarray(words)
 
 
 class VotePlaneGroup:
@@ -377,13 +396,10 @@ class VotePlaneGroup:
         snapshot (pipelined mode) — quorum state may be newer on device."""
         return self._inflight is not None
 
-    def _flush_pipelined(self) -> None:
-        # 1. absorb the step dispatched LAST tick (usually complete by
-        # now: the whole tick's host work overlapped its round-trip)
-        if self._inflight is not None:
-            events, self._inflight = self._inflight, None
-            self._absorb(events)
-        # 2. dispatch this tick's votes; events ride to the host next tick
+    def _dispatch_pending(self):
+        """Chunk + scatter every member's pending votes (async dispatch);
+        returns the LAST chained step's events (they reflect every vote
+        dispatched here), or None if nothing was pending."""
         events = None
         while any(m._pending for m in self._members):
             chunks = []
@@ -393,25 +409,48 @@ class VotePlaneGroup:
                                     m._pending[FLUSH_BATCH:])
                 chunks.append(take)
                 votes += len(take)
-            msgs = self._place(_pack_group_messages(chunks, FLUSH_BATCH))
-            self._states, events = _group_step(self._states, msgs, self._n)
+            words = self._place(_pack_group_words(chunks, FLUSH_BATCH))
+            self._states, events = _group_step_words(
+                self._states, words, self._n)
             self.flushes += 1
             self.metrics.add_event(MetricsName.DEVICE_FLUSH)
             self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
+        return events
+
+    def _dispatch_empty(self):
+        """One padded no-vote step (cold start needs SOME events)."""
+        words = self._place(_pack_group_words(
+            [[] for _ in self._members], FLUSH_BATCH))
+        self._states, events = _group_step_words(
+            self._states, words, self._n)
+        self.flushes += 1
+        self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+        return events
+
+    def _flush_pipelined(self) -> None:
+        # 1. absorb the step dispatched LAST tick (usually complete by
+        # now: the whole tick's host work overlapped its round-trip)
+        self._sync_inflight()
+        # 2. dispatch this tick's votes; events ride to the host next tick
+        events = self._dispatch_pending()
         if events is not None:
-            # the LAST chained step's events reflect every vote above
+            # the LAST chained step's events reflect every vote above.
+            # Kick the device->host copy off NOW: by the time next tick's
+            # absorb runs, the bytes are already host-side and device_get
+            # returns without a link round-trip (measured: the blocking
+            # cost of a flush drops to ~0 on a remote device link).
+            for arr in (events.prepared, events.prepare_counts,
+                        events.commit_counts, events.stable_checkpoints):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — backends without async
+                    break  # copy: device_get pays the round-trip as before
             self._inflight = events
         if self._host_prepared is None:
             # cold start (or post-slide/reset): callers need SOME snapshot
             if self._inflight is None:
-                msgs = self._place(_pack_group_messages(
-                    [[] for _ in self._members], FLUSH_BATCH))
-                self._states, self._inflight = _group_step(
-                    self._states, msgs, self._n)
-                self.flushes += 1
-                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
-            events, self._inflight = self._inflight, None
-            self._absorb(events)
+                self._inflight = self._dispatch_empty()
+            self._sync_inflight()
 
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
@@ -423,30 +462,9 @@ class VotePlaneGroup:
                 and self._host_prepared is not None):
             return
         with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
-            stepped = False
-            while any(m._pending for m in self._members):
-                chunks = []
-                votes = 0
-                for m in self._members:
-                    take, m._pending = (m._pending[:FLUSH_BATCH],
-                                        m._pending[FLUSH_BATCH:])
-                    chunks.append(take)
-                    votes += len(take)
-                msgs = self._place(_pack_group_messages(chunks, FLUSH_BATCH))
-                self._states, events = _group_step(
-                    self._states, msgs, self._n)
-                self.flushes += 1
-                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
-                self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES,
-                                       votes)
-                stepped = True
-            if not stepped:  # cold start: no votes recorded anywhere yet
-                msgs = self._place(_pack_group_messages(
-                    [[] for _ in self._members], FLUSH_BATCH))
-                self._states, events = _group_step(
-                    self._states, msgs, self._n)
-                self.flushes += 1
-                self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+            events = self._dispatch_pending()
+            if events is None:  # cold start: no votes recorded anywhere yet
+                events = self._dispatch_empty()
             # ONE bundled device->host transfer (separate np.asarray calls
             # cost one link round-trip each — painful on a remote device)
             self._absorb(events)
@@ -495,7 +513,7 @@ class _MemberPlane(DeviceVotePlane):
         self._log_size = log_size
         self._n_chk = n_checkpoints
         self._h = h
-        self._pending: List[tuple] = []
+        self._pending: List[int] = []  # uint32 vote words (q.pack_vote)
         self._events = None
         self._seen_version = -1
         self._host_prepared = None

@@ -41,14 +41,16 @@ def main():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
 
     # warm-up
-    submit(batch)
     deadline = time.monotonic() + 240
+    submit(batch)
     while min_ordered() < batch and time.monotonic() < deadline:
         pool.run_for(0.5)
     assert min_ordered() >= batch, "warm-up stalled"
 
     submit(txns)
     target = batch + txns
+    deadline = time.monotonic() + 240  # fresh budget: warm-up (XLA
+    # compile + flaky link) must not silently truncate the profiled run
     prof = cProfile.Profile()
     t0 = time.perf_counter()
     prof.enable()

@@ -545,7 +545,27 @@ def bench_bls_multisig() -> dict:
         t0 = time.perf_counter()
         cycle()
         times.append(time.perf_counter() - t0)
-    spread, median = _spread(times)
+    single_spread, single_median = _spread(times)
+
+    # the round-5 batched plane: k ordered batches aggregated AND
+    # verified in (|apk groups|+1) Miller loops + ONE shared final
+    # exponentiation (random-linear-combination batch verification)
+    k_batch = 16
+    items = []
+    for j in range(k_batch):
+        m_j = msg + b"|batch:%d" % j
+        items.append(([BlsCryptoSigner(kp).sign(m_j) for kp in kps],
+                      m_j, pks))
+    out = BlsCryptoVerifier.aggregate_and_verify_batch(items)  # warm
+    assert all(ok for _, ok in out)
+    btimes = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = BlsCryptoVerifier.aggregate_and_verify_batch(items)
+        btimes.append(time.perf_counter() - t0)
+    assert all(ok for _, ok in out)
+    spread, bmedian = _spread(btimes)
+    median = bmedian / k_batch  # amortized per ordered batch
     value = 1.0 / median
 
     # same-machine oracle baseline: one affine-path verification cycle
@@ -568,23 +588,43 @@ def bench_bls_multisig() -> dict:
     # is 2 pairings + 64 G2 adds + hash-to-curve, so a reference-class
     # backend lands at roughly 3-9 ms/cycle (~110-330 cycles/sec).
     reference_class_cycle_ms = (3.0, 9.0)
+    # a NEW metric name for the batched plane: the round-1..4 metric
+    # bls_aggregate_verify_64_per_sec was the single-cycle rate, and a
+    # silent 16x redefinition under the old name would corrupt
+    # round-over-round comparisons (the round-4 advisor caught exactly
+    # this pattern on the catchup metric)
     return {
-        "metric": "bls_aggregate_verify_64_per_sec",
+        "metric": "bls_agg_verify_64_batched%d_per_sec" % k_batch,
         "value": round(value, 2),
-        "unit": "agg+verify cycles/sec",
+        "unit": "agg+verify batches/sec (amortized across %d ordered "
+                "batches, one shared final exponentiation)" % k_batch,
         "vs_baseline": round(
             value / (1e3 / reference_class_cycle_ms[1]), 3),
-        "baseline_note": "absolute: %.2f ms/cycle (64 sigs). External "
-                         "yardstick: AMCL/Milagro-class BN254 (the "
-                         "reference's ursa backend) at published "
-                         "~1.5-4ms/pairing => ~3-9ms/cycle; vs_baseline "
-                         "uses the conservative 9ms end. Same-machine "
-                         "affine oracle: %.2f/sec. Backend: %s"
-                         % (median * 1e3, 1.0 / oracle_s,
+        "baseline_note": "absolute: %.3f ms/batch amortized; the bench "
+                         "chose k=%d — production defers per quorum tick, "
+                         "so real amortization is workload-dependent "
+                         "(ticks ordering 2 batches amortize 2x). The "
+                         "old single-cycle metric "
+                         "(bls_aggregate_verify_64_per_sec, rounds 1-4) "
+                         "measures %.2f ms this round — see "
+                         "single_cycle_per_sec for the comparable "
+                         "number. External yardstick: AMCL/Milagro-class "
+                         "BN254 (the reference's ursa backend) at "
+                         "published ~1.5-4ms/pairing => ~3-9ms/cycle; "
+                         "vs_baseline uses the conservative 9ms end. "
+                         "Same-machine affine oracle: %.2f/sec. "
+                         "Backend: %s"
+                         % (median * 1e3, k_batch, single_median * 1e3,
+                            1.0 / oracle_s,
                             "native C (the reference's Rust-analog)"
                             if NATIVE_BACKEND else "pure-Python projective"),
+        "single_cycle_ms": round(single_median * 1e3, 3),
+        "single_cycle_per_sec": round(1.0 / single_median, 2),
+        "batched_ms_per_batch": round(median * 1e3, 3),
+        "batch_k": k_batch,
         "n_validators": n,
         "spread": spread,
+        "single_spread": single_spread,
         "reference_class_cycle_ms": list(reference_class_cycle_ms),
     }
 

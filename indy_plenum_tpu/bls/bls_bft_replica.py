@@ -62,6 +62,13 @@ class BlsBftReplica:
         # (view_no, pp_seq_no) -> sender -> sig b58
         self._sigs: Dict[Tuple[int, int], Dict[str, str]] = {}
         self._latest_multi_sig: Optional[MultiSignature] = None
+        # deferred mode (set by tick-driven compositions): process_order
+        # queues its aggregate checks and flush() verifies ALL batches
+        # ordered this tick in one random-linear-combination multi-
+        # pairing (BlsCryptoVerifier.verify_multi_sig_batch) — one shared
+        # final exponentiation per tick instead of one pairing per batch
+        self.defer_verification = False
+        self._pending_orders: list = []
 
     # --- value under signature -----------------------------------------
 
@@ -92,6 +99,12 @@ class BlsBftReplica:
         except (KeyError, TypeError, ValueError):
             raise SuspiciousNode(
                 sender, Suspicions.PPR_BLS_MULTISIG_WRONG) from None
+        # steady-state memo: the attached multi-sig is almost always one
+        # WE assembled (or already verified) for that state root — an
+        # identical store entry needs no second pairing check
+        known = self._store.get(ms.value.state_root_hash)
+        if known is not None and known == ms:
+            return
         pks = self._register.get_keys(ms.participants)
         if pks is None or not self._verifier.verify_multi_sig(
                 ms.signature, ms.value.serialize(), pks):
@@ -188,29 +201,73 @@ class BlsBftReplica:
         pks = self._register.get_keys(participants)
         if pks is None:
             return
+        if self.defer_verification:
+            # verified in ONE multi-pairing with everything else ordered
+            # this tick (flush()); ordering itself never waited on the
+            # multi-sig — it only feeds proved reads + the next PP
+            self._pending_orders.append(
+                (key, quorums, value, participants, agg, sigs, message,
+                 pks, _aggregate))
+            return
         if not self._verifier.verify_multi_sig(agg, message, pks):
-            # optimistic path failed: find the culprit(s) individually
-            good = []
-            for p in participants:
-                pk = self._register.get_key(p)
-                if pk and self._verifier.verify_sig(sigs[p], message, pk):
-                    good.append(p)
-                elif p == self._name:
-                    logger.error("%s: OWN BLS sig failed verification at %s",
-                                 self._name, key)
-                else:
-                    logger.warning("%s: invalid BLS sig from %s at %s",
-                                   self._name, p, key)
-                    self._suspicion_sink(
-                        SuspiciousNode(p, Suspicions.CM_BLS_WRONG))
-            if not quorums.bls_signatures.is_reached(len(good)):
+            retry = self._retry_without_culprits(
+                key, quorums, sigs, message, participants, _aggregate)
+            if retry is None:
                 return
-            participants = good
-            agg = _aggregate(participants)
+            participants, agg = retry
         ms = MultiSignature(signature=agg, participants=participants,
                             value=value)
         self._store.put(ms)
         self._latest_multi_sig = ms
+
+    def _retry_without_culprits(self, key, quorums, sigs, message,
+                                participants, aggregate_fn):
+        """Aggregate check failed: identify bad signers individually,
+        raise suspicions, and retry with the good subset. Returns
+        (good_participants, good_aggregate) or None if no quorum of good
+        signatures remains."""
+        good = []
+        for p in participants:
+            pk = self._register.get_key(p)
+            if pk and self._verifier.verify_sig(sigs[p], message, pk):
+                good.append(p)
+            elif p == self._name:
+                logger.error("%s: OWN BLS sig failed verification at %s",
+                             self._name, key)
+            else:
+                logger.warning("%s: invalid BLS sig from %s at %s",
+                               self._name, p, key)
+                self._suspicion_sink(
+                    SuspiciousNode(p, Suspicions.CM_BLS_WRONG))
+        if not quorums.bls_signatures.is_reached(len(good)):
+            return None
+        return good, aggregate_fn(good)
+
+    def flush(self) -> None:
+        """Verify every batch ordered since the last tick in one
+        random-linear-combination multi-pairing; store the proven
+        multi-sigs (deferred mode's tick hook — a no-op otherwise)."""
+        if not self._pending_orders:
+            return
+        batch, self._pending_orders = self._pending_orders, []
+        # through the instance seam (compositions may substitute or
+        # instrument the verifier), same as every other verification path
+        verdicts = self._verifier.verify_multi_sig_batch(
+            [(agg, message, pks)
+             for (_k, _q, _v, _p, agg, _s, message, pks, _a) in batch])
+        for ok, (key, quorums, value, participants, agg, sigs, message,
+                 pks, aggregate_fn) in zip(verdicts, batch):
+            if not ok:
+                retry = self._retry_without_culprits(
+                    key, quorums, sigs, message, participants,
+                    aggregate_fn)
+                if retry is None:
+                    continue
+                participants, agg = retry
+            ms = MultiSignature(signature=agg, participants=participants,
+                                value=value)
+            self._store.put(ms)
+            self._latest_multi_sig = ms
 
     # --- GC -------------------------------------------------------------
 

@@ -32,6 +32,18 @@ logger = logging.getLogger(__name__)
 DEFAULT_PROOF_MAX_AGE = 300.0  # seconds
 
 
+class RequestRejected(Exception):
+    """Raised by :meth:`Client.take_result` when the pool NACKed the
+    request (>f distinct rejections). Carries the evidence and frees the
+    request's state — a poll loop terminates instead of spinning, and a
+    long-running client doesn't accumulate rejected entries."""
+
+    def __init__(self, digest: str, nacks: Dict[str, str]):
+        super().__init__(f"request {digest} rejected: {nacks}")
+        self.digest = digest
+        self.nacks = dict(nacks)
+
+
 class PendingRequest:
     def __init__(self, request: Request, needed: int):
         self.request = request
@@ -294,16 +306,21 @@ class Client:
         return state.result if state else None
 
     def take_result(self, digest: str) -> Optional[dict]:
-        """``result()`` + retire: the long-running-client happy path.
-        Returns None without retiring while the quorum is pending OR the
-        request was rejected — rejection evidence stays queryable via
-        ``is_rejected``/``pending[digest].nacks``; call ``retire()``
-        after inspecting it (rejected requests are the caller's to free,
-        or they accumulate like any unconsumed result)."""
+        """``result()`` + retire: the long-running-client shape. Returns
+        the result (and frees the slot) on success, None while the
+        quorum is pending, and raises :class:`RequestRejected` — with
+        the NACK evidence attached, freeing the slot — once >f nodes
+        rejected, so a poll loop always terminates and neither outcome
+        leaks memory."""
         res = self.result(digest)
         if res is not None:
             self.retire(digest)
-        return res
+            return res
+        if self.is_rejected(digest):
+            nacks = dict(self.pending[digest].nacks)
+            self.retire(digest)
+            raise RequestRejected(digest, nacks)
+        return None
 
     def retire(self, digest: str) -> None:
         """Forget a request: frees its memory AND releases its

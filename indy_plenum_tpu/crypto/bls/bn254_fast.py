@@ -162,6 +162,13 @@ def g2_mul(pt: bn.G2Point, k: int) -> bn.G2Point:
     return (f2_mul(X, zi2), f2_mul(Y, f2_mul(zi2, zi)))
 
 
+def fp_sqrt(x: int):
+    """sqrt mod P, or None for a non-residue (same API as the C backend)."""
+    x %= bn.P
+    y = pow(x, (bn.P + 1) // 4, bn.P)
+    return y if y * y % bn.P == x else None
+
+
 def g2_in_subgroup(pt: bn.G2Point) -> bool:
     """[R]Q == O via an UNREDUCED Jacobian ladder — g2_mul reduces the
     scalar mod R, which would turn this check into a tautology and admit

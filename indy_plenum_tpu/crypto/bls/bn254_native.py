@@ -12,11 +12,8 @@ callers select the backend via :func:`available`.
 """
 from __future__ import annotations
 
-import importlib.util
 import logging
 import os
-import subprocess
-import sysconfig
 from typing import Optional, Tuple
 
 from . import bn254 as bn
@@ -30,32 +27,9 @@ _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _build_and_load():
-    src = os.path.abspath(_SRC)
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    # ABI-tagged artifact name: a .so built by one CPython must never be
-    # loaded into another (segfault or silent pure-Python fallback)
-    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so_path = os.path.join(_BUILD_DIR, f"bn254c{ext}")
-    if (not os.path.exists(so_path)
-            or os.path.getmtime(so_path) < os.path.getmtime(src)):
-        include = sysconfig.get_paths()["include"]
-        # build to a temp path + atomic rename: a concurrent importer must
-        # never load a half-written ELF (it would silently fall back to
-        # the pure-Python backend for its whole session)
-        tmp_path = f"{so_path}.tmp.{os.getpid()}"
-        cmd = ["gcc", "-O3", "-shared", "-fPIC", f"-I{include}",
-               src, "-o", tmp_path]
-        logger.info("building native BN254 backend: %s", " ".join(cmd))
-        try:
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(tmp_path, so_path)
-        finally:
-            if os.path.exists(tmp_path):
-                os.unlink(tmp_path)
-    spec = importlib.util.spec_from_file_location("bn254c", so_path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    from ...utils.native_build import build_native_ext
+
+    return build_native_ext(_SRC, _BUILD_DIR, "bn254c")
 
 
 _C = _build_and_load()
@@ -104,6 +78,12 @@ def g1_mul(pt: bn.G1Point, k: int) -> bn.G1Point:
     return _g1_from(_C.g1_mul(_g1_bytes(pt), _scalar(k)))
 
 
+def fp_sqrt(x: int):
+    """sqrt mod P, or None if ``x`` is a non-residue (C fast path)."""
+    out = _C.fp_sqrt((x % bn.P).to_bytes(32, "big"))
+    return None if out is None else int.from_bytes(out, "big")
+
+
 def g2_mul(pt: bn.G2Point, k: int) -> bn.G2Point:
     return _g2_from(_C.g2_mul(_g2_bytes(pt), _scalar(k)))
 
@@ -111,6 +91,15 @@ def g2_mul(pt: bn.G2Point, k: int) -> bn.G2Point:
 def g1_sum(points) -> bn.G1Point:
     return _g1_from(_C.g1_sum(
         [_g1_bytes(p) for p in points if p is not None]))
+
+
+def g1_sum_checked_bytes(raws) -> bytes:
+    """Sum raw 64-byte G1 encodings with canonical + on-curve validation
+    done in C (raises ValueError on any invalid encoding); returns the
+    64-byte aggregate (all-zeros for the identity). The aggregation hot
+    path — no per-point int conversion crosses the boundary."""
+    out = _C.g1_sum_checked(raws)
+    return b"\x00" * 64 if out is None else out
 
 
 def g2_sum(points) -> bn.G2Point:

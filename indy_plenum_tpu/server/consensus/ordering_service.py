@@ -94,6 +94,9 @@ class NoOpBlsBftReplica:
     def process_order(self, key, quorums, pp) -> None:
         pass
 
+    def flush(self) -> None:
+        pass
+
     def gc(self, key_3pc) -> None:
         pass
 
@@ -181,6 +184,10 @@ class OrderingService:
         # Queries read the last-synced snapshot (plane.defer_flush_on_query).
         self._tick_mode = (vote_plane is not None
                            and self._config.QuorumTickInterval > 0)
+        if self._tick_mode and hasattr(self._bls, "defer_verification"):
+            # batch the per-ordered-batch BLS aggregate checks per tick:
+            # service_quorum_tick flushes them through ONE multi-pairing
+            self._bls.defer_verification = True
         self._dirty_prepare_keys: set = set()
         self._order_dirty = False
 
@@ -269,6 +276,9 @@ class OrderingService:
             self._order_dirty = True
             self._dirty_prepare_keys |= {
                 k for k in keys if k not in self.ordered}
+        # every batch _try_order delivered above queued its BLS aggregate
+        # check (deferred mode): ONE multi-pairing proves them all
+        self._bls.flush()
 
     @property
     def name(self) -> str:

@@ -233,14 +233,21 @@ def pack_vote(kind: int, sender: int, slot: int) -> int:
     return 0x80000000 | (kind << 29) | (sender << 16) | slot
 
 
+def words_row(packed_words, max_batch: int) -> np.ndarray:
+    """(already-packed uint32 vote ints) -> zero-padded (max_batch,) row.
+    The ONE definition of the padded row layout every flush path uses."""
+    out = np.zeros(max_batch, np.uint32)
+    out[: len(packed_words)] = np.fromiter(packed_words, np.uint32,
+                                           len(packed_words))
+    return out
+
+
 def pack_words(entries, max_batch: int) -> np.ndarray:
     """Host helper: (kind, sender, slot) triples -> (max_batch,) uint32.
 
     Same vote-inclusion contract as :func:`pack_messages`."""
-    out = np.zeros(max_batch, np.uint32)
-    for i, (k, s, sl) in enumerate(entries):
-        out[i] = pack_vote(k, s, sl)
-    return out
+    return words_row([pack_vote(k, s, sl) for k, s, sl in entries],
+                     max_batch)
 
 
 def pack_messages(

@@ -51,13 +51,6 @@ def _step_words(state: q.VoteState, words, n_validators: int):
     return q.step(state, q.unpack_words(words), n_validators)
 
 
-def _words_row(entries, max_batch: int) -> np.ndarray:
-    """(already-packed uint32 vote ints) -> padded (max_batch,) row."""
-    out = np.zeros(max_batch, np.uint32)
-    out[: len(entries)] = np.fromiter(entries, np.uint32, len(entries))
-    return out
-
-
 def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
     """Roll the slot axis left by ``delta`` and zero the vacated columns."""
     s = state.prepare_votes.shape[1]
@@ -236,7 +229,7 @@ class DeviceVotePlane:
         while self._pending:
             chunk, self._pending = (self._pending[:FLUSH_BATCH],
                                     self._pending[FLUSH_BATCH:])
-            words = jnp.asarray(_words_row(chunk, FLUSH_BATCH))
+            words = jnp.asarray(q.words_row(chunk, FLUSH_BATCH))
             self._state, self._events = _step_words(
                 self._state, words, self._n)
             self.flushes += 1
@@ -245,7 +238,7 @@ class DeviceVotePlane:
         self._flush()
         if self._events is None:  # nothing ever recorded
             self._state, self._events = _step_words(
-                self._state, jnp.asarray(_words_row([], FLUSH_BATCH)),
+                self._state, jnp.asarray(q.words_row([], FLUSH_BATCH)),
                 self._n)
         (self._host_prepared, self._host_prepare_counts,
          self._host_commit_counts, self._host_stable) = jax.device_get(
@@ -295,15 +288,10 @@ def _pack_group_words(chunks: List[List[int]], max_batch: int
     One vectorized row write per member (a dense-pool tick flushes tens
     of thousands of votes) and one word per vote on the wire — the
     host->device transfer is the blocking cost of a flush."""
-    m = len(chunks)
-    words = np.zeros((m, max_batch), np.uint32)
-    for j, entries in enumerate(chunks):
-        if entries:
-            # entries are pre-packed words (q.pack_vote at record time):
-            # one fromiter per member, no tuple-list conversion
-            words[j, :len(entries)] = np.fromiter(
-                entries, np.uint32, len(entries))
-    return jnp.asarray(words)
+    # entries are pre-packed words (q.pack_vote at record time); one
+    # vectorized q.words_row per member, no tuple-list conversion
+    return jnp.asarray(np.stack(
+        [q.words_row(entries, max_batch) for entries in chunks]))
 
 
 class VotePlaneGroup:

@@ -3,6 +3,11 @@
 The environment has no ``base58`` package; identifiers, verkeys and merkle
 roots are base58-encoded on the wire exactly as in the reference
 (plenum/common/messages/fields.py `Base58Field`, `MerkleRootField`).
+
+A native codec (native/codec/b58c.c, built on first use like the BN254
+backend) serves the hot paths — BLS signature shares, roots and digests
+all cross as base58; the pure-Python functions below remain the oracle
+and the fallback when no compiler is available.
 """
 from __future__ import annotations
 
@@ -10,7 +15,27 @@ ALPHABET = b"123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
 _INDEX = {c: i for i, c in enumerate(ALPHABET)}
 
 
+try:
+    import os as _os
+
+    from .native_build import build_native_ext as _build
+
+    _HERE = _os.path.dirname(_os.path.abspath(__file__))
+    _C = _build(_os.path.join(_HERE, "..", "..", "native", "codec",
+                              "b58c.c"),
+                _os.path.join(_HERE, "_native_build"), "b58c", opt="-O2")
+except Exception as _err:  # pragma: no cover — no compiler/headers
+    import logging as _logging
+
+    _logging.getLogger(__name__).warning(
+        "native base58 codec unavailable (%s); using the ~10x slower "
+        "pure-Python fallback", _err)
+    _C = None
+
+
 def b58encode(data: bytes) -> str:
+    if _C is not None:
+        return _C.b58_encode(bytes(data))
     n_zeros = len(data) - len(data.lstrip(b"\0"))
     num = int.from_bytes(data, "big")
     out = bytearray()
@@ -22,15 +47,27 @@ def b58encode(data: bytes) -> str:
     return out.decode("ascii")
 
 
+_POW58 = [58 ** i for i in range(11)]
+
+
 def b58decode(text: str | bytes) -> bytes:
+    if _C is not None:
+        return _C.b58_decode(text)
     if isinstance(text, str):
         text = text.encode("ascii")
     n_zeros = len(text) - len(text.lstrip(ALPHABET[0:1]))
     num = 0
-    for ch in text:
-        try:
-            num = num * 58 + _INDEX[ch]
-        except KeyError:
-            raise ValueError(f"invalid base58 character {ch!r}") from None
+    try:
+        # 10-digit chunks: the inner loop stays on machine ints and the
+        # big-int ops drop ~10x (signature decoding is a hot path)
+        for i in range(0, len(text), 10):
+            chunk = text[i:i + 10]
+            v = 0
+            for ch in chunk:
+                v = v * 58 + _INDEX[ch]
+            num = num * _POW58[len(chunk)] + v
+    except KeyError as exc:
+        raise ValueError(
+            f"invalid base58 character {exc.args[0]!r}") from None
     body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
     return b"\0" * n_zeros + body

@@ -1,0 +1,52 @@
+"""Build-on-first-use loader for the repo's C extensions.
+
+Reference analog: the reference ships prebuilt native wheels
+(indy-crypto etc.); here the toolchain image has gcc + CPython headers,
+so extensions compile lazily and cache next to their consumer. One
+definition of the recipe — ABI-tagged artifact names, mtime-based
+rebuild, atomic tmp+rename publish so a concurrent importer never loads
+half an ELF — shared by every native module (BN254, base58).
+"""
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+
+def build_native_ext(src_path: str, build_dir: str, name: str,
+                     opt: str = "-O3"):
+    """Compile ``src_path`` into ``build_dir`` (if stale) and import it.
+
+    Raises on any build/load failure — callers decide whether to fall
+    back to a pure-Python implementation.
+    """
+    src = os.path.abspath(src_path)
+    os.makedirs(build_dir, exist_ok=True)
+    # ABI-tagged artifact name: a .so built by one CPython must never be
+    # loaded into another (segfault or silent pure-Python fallback)
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so_path = os.path.join(build_dir, f"{name}{ext}")
+    if (not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < os.path.getmtime(src)):
+        include = sysconfig.get_paths()["include"]
+        # build to a temp path + atomic rename: a concurrent importer must
+        # never load a half-written ELF
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
+        cmd = ["gcc", opt, "-shared", "-fPIC", f"-I{include}",
+               src, "-o", tmp_path]
+        logger.info("building native extension: %s", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(tmp_path, so_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+    spec = importlib.util.spec_from_file_location(name, so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -179,6 +179,30 @@ static inline void fp_set_small(fp *r, uint64_t x) {
     fp_mul(r, &raw, &FP_R2);
 }
 
+/* sqrt via pow(a, (P+1)/4): P = 3 mod 4.  Variable-time is fine — the
+ * only caller is hash-to-curve over PUBLIC protocol data. */
+static const unsigned char SQRT_EXP_BE[32] = {
+    0x0c, 0x19, 0x13, 0x9c, 0xb8, 0x4c, 0x68, 0x0a,
+    0x6e, 0x14, 0x11, 0x6d, 0xa0, 0x60, 0x56, 0x17,
+    0x65, 0xe0, 0x5a, 0xa4, 0x5a, 0x1c, 0x72, 0xa3,
+    0x4f, 0x08, 0x23, 0x05, 0xb6, 0x1f, 0x3f, 0x52};
+static void fp_pow_be(fp *r, const fp *a, const unsigned char *e_be32) {
+    fp out = FP_R1, base = *a;
+    int started = 0;
+    /* MSB-first square-and-multiply, skipping leading zero bits */
+    for (int i = 0; i < 32; i++) {
+        unsigned char byte = e_be32[i];
+        for (int b = 7; b >= 0; b--) {
+            if (started) fp_sqr(&out, &out);
+            if ((byte >> b) & 1) {
+                if (started) fp_mul(&out, &out, &base);
+                else { out = base; started = 1; }
+            }
+        }
+    }
+    *r = out;
+}
+
 /* ---- fp2 ------------------------------------------------------------- */
 
 static inline void f2_add(fp2 *r, const fp2 *x, const fp2 *y) {
@@ -805,11 +829,17 @@ static PyObject *py_g1_mul(PyObject *self, PyObject *args) {
         return NULL;
     g1j acc; memset(&acc, 0, sizeof acc); acc.y = FP_R1;
     if (!inf) {
+        int started = 0;  /* skip leading zero bits: short (e.g. 128-bit
+                           * batch-verify) scalars cost half a full mul */
         for (int i = 0; i < 32; i++) {
             unsigned char byte = k[i];
+            if (!started && byte == 0) continue;
             for (int b = 7; b >= 0; b--) {
-                g1j_double(&acc, &acc);
-                if ((byte >> b) & 1) g1j_add_affine(&acc, &acc, &x, &y);
+                if (started) g1j_double(&acc, &acc);
+                if ((byte >> b) & 1) {
+                    g1j_add_affine(&acc, &acc, &x, &y);
+                    started = 1;
+                }
             }
         }
     }
@@ -824,16 +854,91 @@ static PyObject *py_g2_mul(PyObject *self, PyObject *args) {
         return NULL;
     g2j acc; g2j_set_inf(&acc);
     if (!inf) {
+        int started = 0;  /* as in g1_mul: skip leading zero bits */
         for (int i = 0; i < 32; i++) {
             unsigned char byte = k[i];
+            if (!started && byte == 0) continue;
             for (int b = 7; b >= 0; b--) {
-                g2j_double(&acc, &acc);
-                if ((byte >> b) & 1) g2j_add_affine(&acc, &acc, &x, &y);
+                if (started) g2j_double(&acc, &acc);
+                if ((byte >> b) & 1) {
+                    g2j_add_affine(&acc, &acc, &x, &y);
+                    started = 1;
+                }
             }
         }
     }
     return g2_to_py(&acc);
 }
+static int be32_lt_p(const unsigned char *be32) {
+    /* raw big-endian value < P? (canonical-encoding check; FP_P holds
+     * the raw prime limbs — Montgomery form applies to elements only) */
+    unsigned char p_be[32];
+    for (int i = 0; i < 4; i++) {
+        uint64_t w = FP_P.v[3 - i];
+        for (int j = 0; j < 8; j++) {
+            p_be[i * 8 + j] = (unsigned char)(w >> (8 * (7 - j)));
+        }
+    }
+    return memcmp(be32, p_be, 32) < 0;
+}
+
+static int g1_on_curve_mont(const fp *x, const fp *y) {
+    fp y2, x2, x3, three;
+    fp_sqr(&y2, y);
+    fp_sqr(&x2, x);
+    fp_mul(&x3, &x2, x);
+    fp_set_small(&three, 3);
+    fp_add(&x3, &x3, &three);
+    return fp_eq(&y2, &x3);
+}
+
+static PyObject *py_g1_sum_checked(PyObject *self, PyObject *args) {
+    /* Sum raw 64-byte G1 encodings with canonical + on-curve validation
+     * in C — the signature-share aggregation hot path, sparing the host
+     * a bytes->int->python-check->bytes round-trip per share.  All-zero
+     * bytes = the identity (contributes nothing); anything else invalid
+     * raises ValueError. */
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "O", &seq)) return NULL;
+    PyObject *it = PyObject_GetIter(seq);
+    if (!it) return NULL;
+    g1j acc; memset(&acc, 0, sizeof acc); acc.y = FP_R1;
+    static const unsigned char zeros[64] = {0};
+    PyObject *item;
+    while ((item = PyIter_Next(it)) != NULL) {
+        char *buf; Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &buf, &len) < 0) {
+            Py_DECREF(item); Py_DECREF(it); return NULL;
+        }
+        if (len != 64) {
+            Py_DECREF(item); Py_DECREF(it);
+            PyErr_SetString(PyExc_ValueError, "G1 needs 64 bytes");
+            return NULL;
+        }
+        if (memcmp(buf, zeros, 64) == 0) { Py_DECREF(item); continue; }
+        if (!be32_lt_p((unsigned char *)buf)
+                || !be32_lt_p((unsigned char *)buf + 32)) {
+            Py_DECREF(item); Py_DECREF(it);
+            PyErr_SetString(PyExc_ValueError,
+                            "non-canonical G1 coordinate");
+            return NULL;
+        }
+        fp x, y;
+        fp_from_bytes_be(&x, (unsigned char *)buf);
+        fp_from_bytes_be(&y, (unsigned char *)buf + 32);
+        Py_DECREF(item);
+        if (!g1_on_curve_mont(&x, &y)) {
+            Py_DECREF(it);
+            PyErr_SetString(PyExc_ValueError, "point not on G1");
+            return NULL;
+        }
+        g1j_add_affine(&acc, &acc, &x, &y);
+    }
+    Py_DECREF(it);
+    if (PyErr_Occurred()) return NULL;
+    return g1_to_py(&acc);
+}
+
 static PyObject *py_g1_sum(PyObject *self, PyObject *args) {
     PyObject *seq;
     if (!PyArg_ParseTuple(args, "O", &seq)) return NULL;
@@ -951,10 +1056,32 @@ static PyObject *py_pairing_check(PyObject *self, PyObject *args) {
     Py_RETURN_FALSE;
 }
 
+static PyObject *py_fp_sqrt(PyObject *self, PyObject *args) {
+    /* sqrt in Fp (P = 3 mod 4): bytes32 -> bytes32 | None (non-residue).
+     * Serves hash-to-curve's try-and-increment; the Python modular pow
+     * it replaces was the single hottest host op per hashed message. */
+    PyObject *xobj;
+    if (!PyArg_ParseTuple(args, "O", &xobj)) return NULL;
+    char *buf; Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(xobj, &buf, &len) < 0) return NULL;
+    if (len != 32) { PyErr_SetString(PyExc_ValueError,
+                                     "fp needs 32 bytes"); return NULL; }
+    fp x; fp_from_bytes_be(&x, (unsigned char *)buf);
+    fp y; fp_pow_be(&y, &x, SQRT_EXP_BE);
+    fp y2; fp_sqr(&y2, &y);
+    if (!fp_eq(&y2, &x)) Py_RETURN_NONE;
+    unsigned char out[32]; fp_to_bytes_be(out, &y);
+    return PyBytes_FromStringAndSize((char *)out, 32);
+}
+
 static PyMethodDef Methods[] = {
+    {"fp_sqrt", py_fp_sqrt, METH_VARARGS,
+     "sqrt in Fp (bytes32 -> bytes32 | None)"},
     {"g1_mul", py_g1_mul, METH_VARARGS, "G1 scalar mul (bytes64, bytes32)"},
     {"g2_mul", py_g2_mul, METH_VARARGS, "G2 scalar mul (bytes128, bytes32)"},
     {"g1_sum", py_g1_sum, METH_VARARGS, "sum of G1 points"},
+    {"g1_sum_checked", py_g1_sum_checked, METH_VARARGS,
+     "sum raw bytes64 G1 encodings with canonical+curve checks"},
     {"g2_sum", py_g2_sum, METH_VARARGS, "sum of G2 points"},
     {"g2_in_subgroup", py_g2_in_subgroup, METH_VARARGS,
      "unreduced [R]Q == O check"},

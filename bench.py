@@ -84,27 +84,47 @@ def bench_ed25519() -> dict:
     import jax
     import jax.numpy as jnp
 
-    pk_a, r_a, s_a, h_a, pre = ted.prepare_batch(pks, msgs, sigs)
+    # production path (round 5): the chip computes SHA512(R||A||M) mod L
+    # itself — the host only packs padded blocks (byte moves, no hashing)
+    max_blocks = ted.max_blocks_for(msgs)
+    t0 = time.perf_counter()
+    pk_a, r_a, s_a, blocks, counts, pre = ted.prepare_batch_device(
+        pks, msgs, sigs, max_blocks)
+    prep_new_s = time.perf_counter() - t0
     assert pre.all()
-    args = [jax.device_put(jnp.asarray(a)) for a in (pk_a, r_a, s_a, h_a)]
+    args = [jax.device_put(jnp.asarray(a))
+            for a in (pk_a, r_a, s_a, blocks, counts)]
 
-    ok = np.asarray(_retry(lambda: ted.verify_kernel(*args)))  # compile+warm
+    ok = np.asarray(_retry(lambda: ted.verify_kernel_full(*args)))  # warm
     assert ok.all(), "benchmark batch failed verification"
 
     times = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        _retry(lambda: ted.verify_kernel(*args).block_until_ready())
+        _retry(lambda: ted.verify_kernel_full(*args).block_until_ready())
         times.append(time.perf_counter() - t0)
     spread, median = _spread(times)
     value = ED_BATCH / median
+
+    # round-4 shape for comparison: host hashlib h + curve-only kernel
+    t0 = time.perf_counter()
+    ted.prepare_batch(pks, msgs, sigs)
+    prep_old_s = time.perf_counter() - t0
+    # NEW metric name: rounds 1-4's ed25519_verifies_per_sec_per_chip
+    # timed the curve-only kernel with h hashed on the host; this kernel
+    # additionally does SHA-512 + mod-L on chip — same-name comparison
+    # across rounds would misread the added work as a regression
     return {
-        "metric": "ed25519_verifies_per_sec_per_chip",
+        "metric": "ed25519_full_onchip_verifies_per_sec",
         "value": round(value, 1),
-        "unit": "verifies/sec",
+        "unit": "verifies/sec (SHA-512 + mod-L + curve math all on "
+                "device; successor of ed25519_verifies_per_sec_per_chip)",
         "vs_baseline": round(value / BASELINE_CPU_VERIFIES_PER_SEC, 3),
         "batch": ED_BATCH,
         "spread": spread,
+        "host_prep_us_per_sig": round(prep_new_s / ED_BATCH * 1e6, 2),
+        "host_prep_us_per_sig_round4_path": round(
+            prep_old_s / ED_BATCH * 1e6, 2),
         "device": str(jax.devices()[0]),
     }
 

@@ -36,12 +36,37 @@ logger = logging.getLogger(__name__)
 # batch bucket sizes: pad to the smallest fitting bucket (fixed XLA shapes)
 _BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
+# device-hash tier: SHA-512 block buckets above this take the host-hash
+# path (8 blocks ~ 950-byte messages; protocol requests are far smaller,
+# and message length is client-controlled — see authenticate_batch)
+MAX_DEVICE_HASH_BLOCKS = 8
+
 
 def _bucket(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
             return b
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
+
+
+def warm_device_auth_path(sizes: Sequence[int] = (512,),
+                          block_buckets: Sequence[int] = (1, 2)) -> None:
+    """Pre-compile the device-hash verify shapes OFF the protocol path.
+
+    Every new (batch, max_blocks) shape is a synchronous XLA compile; a
+    deployed node calls this at startup (scripts/start_node.py) so the
+    first full ingress batch doesn't stall consensus on a compile."""
+    from ..tpu import ed25519 as ted
+
+    for size in sizes:
+        for mb in block_buckets:
+            pks = [b"\x00" * 32] * size
+            msgs = [b""] * size
+            sigs = [b"\x00" * 64] * size
+            (pk_a, r_a, s_a, blocks, counts,
+             pre) = ted.prepare_batch_device(pks, msgs, sigs, mb)
+            np.asarray(ted.verify_kernel_full(
+                pk_a, r_a, s_a, blocks, counts))
 
 
 class ClientAuthNr:
@@ -173,8 +198,25 @@ class CoreAuthNr(ClientAuthNr):
         pks += [pks[0]] * pad
         msgs += [msgs[0]] * pad
         sigs += [sigs[0]] * pad
-        pk_a, r_a, s_a, h_a, pre = ted.prepare_batch(pks, msgs, sigs)
-        ok = np.asarray(ted.verify_kernel(pk_a, r_a, s_a, h_a)) & pre
+        # full-device path: SHA512(R||A||M) mod L is computed ON CHIP —
+        # the round-4 host hash loop no longer rides the protocol thread.
+        # Tiered: tiny batches keep the host-hash path (hashlib on a few
+        # messages is cheaper than widening the jit-shape zoo; device
+        # hashing pays off exactly where the host loop was the wall —
+        # full ingress batches). The block bucket is CLAMPED: message
+        # length is client-controlled, and every new (size, max_blocks)
+        # shape is a synchronous XLA compile on the auth path — a client
+        # walking buckets must not stall ingress more than the few
+        # warmable shapes below (oversized messages take the host tier).
+        max_blocks = ted.max_blocks_for(msgs)
+        if size >= 256 and max_blocks <= MAX_DEVICE_HASH_BLOCKS:
+            (pk_a, r_a, s_a, blocks, counts,
+             pre) = ted.prepare_batch_device(pks, msgs, sigs, max_blocks)
+            ok = np.asarray(ted.verify_kernel_full(
+                pk_a, r_a, s_a, blocks, counts)) & pre
+        else:
+            pk_a, r_a, s_a, h_a, pre = ted.prepare_batch(pks, msgs, sigs)
+            ok = np.asarray(ted.verify_kernel(pk_a, r_a, s_a, h_a)) & pre
         owners = np.asarray(entry_req)
         bad_per_req = np.bincount(owners[~ok[:m]], minlength=n)
         return candidate & (bad_per_req == 0)

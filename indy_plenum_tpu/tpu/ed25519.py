@@ -207,6 +207,25 @@ def _verify_kernel(
 verify_kernel = jax.jit(_verify_kernel)
 
 
+def _verify_kernel_full(pk: jnp.ndarray, rb: jnp.ndarray, s: jnp.ndarray,
+                        msg_blocks: jnp.ndarray,
+                        n_blocks: jnp.ndarray) -> jnp.ndarray:
+    """Fully on-device verify: takes (R, A, M) directly.
+
+    ``msg_blocks`` are host-padded SHA-512 blocks of R || A || M (byte
+    moves only — see tpu/sha512.pad_ed25519_messages); the chip computes
+    h = SHA512(R||A||M) mod L and the double-scalar equation. Round 4's
+    host hash loop (the next scaling wall at ~70k verifies/sec) is gone.
+    """
+    from . import sha512 as s512
+
+    h = s512.reduce_mod_l(s512.sha512_blocks(msg_blocks, n_blocks))
+    return _verify_kernel(pk, rb, s, h)
+
+
+verify_kernel_full = jax.jit(_verify_kernel_full)
+
+
 # ---------------------------------------------------------------------------
 # Host wrapper: hashing, range checks, padding to stable batch shapes.
 # ---------------------------------------------------------------------------
@@ -214,6 +233,15 @@ verify_kernel = jax.jit(_verify_kernel)
 
 def _reduce_mod_l(h64: bytes) -> bytes:
     return (int.from_bytes(h64, "little") % ref.L).to_bytes(32, "little")
+
+
+def _structural_ok(pk: bytes, sig: bytes) -> bool:
+    """Per-item admission shared by BOTH host-hash and device-hash prep:
+    the two tiers must reject identically or the size>=256 ingress tier
+    would silently weaken validation."""
+    if len(pk) != 32 or len(sig) != 64:
+        return False
+    return int.from_bytes(sig[32:], "little") < ref.L
 
 
 def prepare_batch(
@@ -228,10 +256,7 @@ def prepare_batch(
     h_a = np.zeros((n, 32), np.uint8)
     pre = np.zeros(n, bool)
     for i, (pk, msg, sig) in enumerate(zip(pks, msgs, sigs)):
-        if len(pk) != 32 or len(sig) != 64:
-            continue
-        s_int = int.from_bytes(sig[32:], "little")
-        if s_int >= ref.L:
+        if not _structural_ok(pk, sig):
             continue
         pre[i] = True
         pk_a[i] = np.frombuffer(pk, np.uint8)
@@ -249,19 +274,70 @@ def _pad_to(n: int) -> int:
     return size
 
 
+def prepare_batch_device(
+    pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes],
+    max_blocks: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+           np.ndarray]:
+    """Host prep for the full-device path: structural checks + padded
+    SHA-512 blocks. NO hashing on the host — only byte moves."""
+    from . import sha512 as s512
+
+    n = len(sigs)
+    pk_a = np.zeros((n, 32), np.uint8)
+    r_a = np.zeros((n, 32), np.uint8)
+    s_a = np.zeros((n, 32), np.uint8)
+    pre = np.zeros(n, bool)
+    prefixes = []
+    kept_msgs = []
+    for i, (pk, msg, sig) in enumerate(zip(pks, msgs, sigs)):
+        if not _structural_ok(pk, sig):
+            prefixes.append(b"\x00" * 64)
+            kept_msgs.append(b"")
+            continue
+        pre[i] = True
+        pk_a[i] = np.frombuffer(pk, np.uint8)
+        r_a[i] = np.frombuffer(sig[:32], np.uint8)
+        s_a[i] = np.frombuffer(sig[32:], np.uint8)
+        prefixes.append(sig[:32] + pk)
+        kept_msgs.append(msg)
+    blocks, counts = s512.pad_ed25519_messages(prefixes, kept_msgs,
+                                               max_blocks)
+    return pk_a, r_a, s_a, blocks, counts, pre
+
+
+def max_blocks_for(msgs: Sequence[bytes]) -> int:
+    """Static SHA-512 block bucket for a batch: power-of-two block
+    counts so jit caches stay small across message-length mixes."""
+    longest = max((len(m) for m in msgs), default=0)
+    need = (64 + longest + 17 + 127) // 128
+    bucket = 1
+    while bucket < need:
+        bucket *= 2
+    return bucket
+
+
 def batch_verify(
     pks: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
-    """Verify a batch of Ed25519 signatures on device; returns (B,) bool."""
+    """Verify a batch of Ed25519 signatures on device; returns (B,) bool.
+
+    Hashing happens ON DEVICE (verify_kernel_full); the host only packs
+    padded blocks and range-checks S.
+    """
     n = len(sigs)
     if n == 0:
         return np.zeros(0, bool)
-    pk_a, r_a, s_a, h_a, pre = prepare_batch(pks, msgs, sigs)
+    max_blocks = max_blocks_for(msgs)
+    pk_a, r_a, s_a, blocks, counts, pre = prepare_batch_device(
+        pks, msgs, sigs, max_blocks)
     size = _pad_to(n)
     pad = size - n
 
     def padded(a):
-        return jnp.asarray(np.pad(a, ((0, pad), (0, 0))))
+        widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+        return jnp.asarray(np.pad(a, widths))
 
-    ok = verify_kernel(padded(pk_a), padded(r_a), padded(s_a), padded(h_a))
+    ok = verify_kernel_full(padded(pk_a), padded(r_a), padded(s_a),
+                            padded(blocks), padded(counts))
     return np.asarray(ok)[:n] & pre

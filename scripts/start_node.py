@@ -34,6 +34,12 @@ def main() -> int:
         interval=config.logRotationInterval)
     looper = Looper()
     node, stack = build_node(directory, name, looper)
+    # compile the device-hash auth shapes BEFORE joining consensus: the
+    # first full ingress batch must not stall the protocol thread on a
+    # synchronous XLA compile
+    from indy_plenum_tpu.server.client_authn import warm_device_auth_path
+
+    warm_device_auth_path()
     node.start()
     looper.add(stack)
     looper.add(node.client_surface)

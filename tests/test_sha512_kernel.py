@@ -1,0 +1,56 @@
+"""Tier-1: device SHA-512 + mod-L + full Ed25519 verify vs host oracles."""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from indy_plenum_tpu.crypto import ed25519 as ed  # noqa: E402
+from indy_plenum_tpu.tpu import ed25519 as ted  # noqa: E402
+from indy_plenum_tpu.tpu import sha512 as s5  # noqa: E402
+
+
+def test_constants_derived_match_fips():
+    assert s5._K64[0] == 0x428a2f98d728ae22
+    assert s5._K64[79] == 0x6c44198c4a475817
+    assert s5._H064[0] == 0x6a09e667f3bcc908
+    assert s5._H064[7] == 0x5be0cd19137e2179
+
+
+def test_sha512_blocks_matches_hashlib():
+    rng = np.random.RandomState(3)
+    msgs = [b"", b"abc", rng.bytes(111), rng.bytes(112), rng.bytes(128),
+            rng.bytes(239), rng.bytes(240), rng.bytes(300)]
+    blocks, counts = s5.pad_ed25519_messages([b""] * len(msgs), msgs, 4)
+    out = np.asarray(s5.sha512_blocks(jnp.asarray(blocks),
+                                      jnp.asarray(counts)))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == hashlib.sha512(m).digest(), len(m)
+
+
+def test_reduce_mod_l_matches_python():
+    rng = np.random.RandomState(5)
+    hs = [rng.bytes(64) for _ in range(8)] + [b"\xff" * 64, b"\x00" * 64,
+                                              b"\x01" + b"\x00" * 63]
+    arr = jnp.asarray(np.stack([np.frombuffer(h, np.uint8) for h in hs]))
+    red = np.asarray(s5.reduce_mod_l(arr))
+    for i, h in enumerate(hs):
+        want = (int.from_bytes(h, "little") % s5._L_INT)
+        assert bytes(red[i]) == want.to_bytes(32, "little"), i
+
+
+def test_full_device_verify_matches_host_hash_path():
+    rng = np.random.RandomState(9)
+    seeds = [rng.bytes(32) for _ in range(8)]
+    pks = [ed.fast_public_key(s) for s in seeds]
+    msgs = [rng.bytes(rng.randint(1, 200)) for _ in range(8)]
+    sigs = [ed.fast_sign(seeds[i], msgs[i]) for i in range(8)]
+    # corrupt two: flipped message + flipped sig byte
+    msgs[3] = msgs[3][:-1] + bytes([msgs[3][-1] ^ 1])
+    sigs[5] = bytes([sigs[5][0] ^ 1]) + sigs[5][1:]
+    got = ted.batch_verify(pks, msgs, sigs)
+    want = np.array([True, True, True, False, True, False, True, True])
+    assert np.array_equal(got, want)

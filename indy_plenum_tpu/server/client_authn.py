@@ -36,12 +36,6 @@ logger = logging.getLogger(__name__)
 # batch bucket sizes: pad to the smallest fitting bucket (fixed XLA shapes)
 _BUCKETS = (8, 32, 128, 512, 2048, 8192)
 
-# device-hash tier: SHA-512 block buckets above this take the host-hash
-# path (8 blocks ~ 950-byte messages; protocol requests are far smaller,
-# and message length is client-controlled — see authenticate_batch)
-MAX_DEVICE_HASH_BLOCKS = 8
-
-
 def _bucket(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
@@ -49,13 +43,23 @@ def _bucket(n: int) -> int:
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
 
 
-def warm_device_auth_path(sizes: Sequence[int] = (512,),
-                          block_buckets: Sequence[int] = (1, 2)) -> None:
+# (size, max_blocks) shapes pre-compiled by warm_device_auth_path: the
+# device-hash tier runs ONLY for these, every other shape takes the host
+# tier — an unwarmed shape must degrade gracefully, never stall the
+# protocol thread on a synchronous XLA compile (batch size and message
+# length are both client-controlled)
+_WARMED_SHAPES: set = set()
+
+
+def warm_device_auth_path(sizes: Sequence[int] = (512, 2048, 8192),
+                          block_buckets: Sequence[int] = (1, 2, 4, 8)
+                          ) -> None:
     """Pre-compile the device-hash verify shapes OFF the protocol path.
 
     Every new (batch, max_blocks) shape is a synchronous XLA compile; a
-    deployed node calls this at startup (scripts/start_node.py) so the
-    first full ingress batch doesn't stall consensus on a compile."""
+    deployed node calls this at startup (scripts/start_node.py) so no
+    ingress batch ever waits on one — shapes NOT warmed here simply take
+    the host-hash tier."""
     from ..tpu import ed25519 as ted
 
     for size in sizes:
@@ -67,6 +71,7 @@ def warm_device_auth_path(sizes: Sequence[int] = (512,),
              pre) = ted.prepare_batch_device(pks, msgs, sigs, mb)
             np.asarray(ted.verify_kernel_full(
                 pk_a, r_a, s_a, blocks, counts))
+            _WARMED_SHAPES.add((size, mb))
 
 
 class ClientAuthNr:
@@ -203,13 +208,12 @@ class CoreAuthNr(ClientAuthNr):
         # Tiered: tiny batches keep the host-hash path (hashlib on a few
         # messages is cheaper than widening the jit-shape zoo; device
         # hashing pays off exactly where the host loop was the wall —
-        # full ingress batches). The block bucket is CLAMPED: message
-        # length is client-controlled, and every new (size, max_blocks)
-        # shape is a synchronous XLA compile on the auth path — a client
-        # walking buckets must not stall ingress more than the few
-        # warmable shapes below (oversized messages take the host tier).
+        # full ingress batches). Only shapes PRE-COMPILED by
+        # warm_device_auth_path are eligible: batch size and message
+        # length are client-controlled, and an unwarmed shape would stall
+        # the protocol thread on a synchronous XLA compile.
         max_blocks = ted.max_blocks_for(msgs)
-        if size >= 256 and max_blocks <= MAX_DEVICE_HASH_BLOCKS:
+        if (size, max_blocks) in _WARMED_SHAPES:
             (pk_a, r_a, s_a, blocks, counts,
              pre) = ted.prepare_batch_device(pks, msgs, sigs, max_blocks)
             ok = np.asarray(ted.verify_kernel_full(

@@ -475,3 +475,61 @@ def test_probe_statuses_are_never_status_evidence():
     for s in ("evil1", "evil2", "evil3"):
         fork.process_ledger_status(low_probe, s)
     assert not fork._tip_votes and not found
+
+
+def test_adaptive_offload_policy_selects_measured_winner():
+    from indy_plenum_tpu.server.catchup.catchup_rep_service import (
+        _AdaptiveOffload,
+    )
+
+    pol = _AdaptiveOffload()
+    assert pol.use_device()  # no data: try the offload
+    pol.note_host(10_000.0)
+    pol.note_device(50_000.0)  # device blocks the loop 5x more
+    assert not pol.use_device()
+    # periodic probe re-tries the losing mode
+    probes = sum(pol.use_device() for _ in range(pol.PROBE_EVERY * 2))
+    assert probes >= 1
+    # a recovered link flips the choice back
+    for _ in range(12):
+        pol.note_device(1_000.0)
+    assert pol.use_device()
+
+
+def test_chunked_device_verify_pumps_to_verdict():
+    import numpy as np
+
+    from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from indy_plenum_tpu.server.catchup.catchup_rep_service import (
+        dispatch_audit_paths_batch,
+    )
+
+    n = 8192  # > CHUNK: the incremental None-pumping path MUST engage
+    rng = np.random.RandomState(4)
+    leaves = [rng.bytes(32) for _ in range(n)]
+    tree = CompactMerkleTree()
+    tree.extend(leaves)
+    idxs = list(range(0, n))
+    paths = [tree.audit_path(i, n) for i in idxs]
+    resolve = dispatch_audit_paths_batch(
+        leaves, idxs, paths, n, tree.root_hash, mode="device")
+    # incremental pumping: None until every chunk's verdict is in
+    nones = 0
+    for _ in range(10):
+        out = resolve()
+        if out is not None:
+            break
+        nones += 1
+    assert out is not None and out.all()
+    assert nones >= 1, "multi-chunk pump never returned None"
+    # force=True blocks to completion in one call
+    resolve2 = dispatch_audit_paths_batch(
+        leaves, idxs, paths, n, tree.root_hash, mode="device")
+    out2 = resolve2(force=True)
+    assert out2 is not None and out2.all()
+    # a corrupted leaf is caught
+    bad = list(leaves)
+    bad[7] = b"\x00" * 32
+    out3 = dispatch_audit_paths_batch(
+        bad, idxs, paths, n, tree.root_hash, mode="device")(force=True)
+    assert not out3[7] and out3[:7].all() and out3[8:].all()

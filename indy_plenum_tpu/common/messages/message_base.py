@@ -68,7 +68,12 @@ class MessageBase:
 
     @property
     def _fields(self) -> Dict[str, Any]:
-        return dict(object.__getattribute__(self, "_values"))
+        # SCHEMA fields only: _values aliases the instance __dict__, so a
+        # stray attribute smuggled in via object.__setattr__ must never
+        # leak into wire serialization, equality, or hashing (a tagged
+        # message would stop round-tripping: "unknown fields")
+        values = object.__getattribute__(self, "_values")
+        return {name: values[name] for name, _v in self.schema}
 
     def as_dict(self) -> Dict[str, Any]:
         out = {OP_FIELD_NAME: self.typename}

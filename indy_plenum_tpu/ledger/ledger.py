@@ -127,6 +127,28 @@ class Ledger:
         self.tree.append(data)
         return txn
 
+    def recover_tree(self) -> int:
+        """Rebuild the Merkle tree from the committed txn log when the
+        hash store is missing or behind it (crash recovery: the ledger
+        LOG is the truth — a lost/stale hash store must never strand a
+        node with an inconsistent root). Returns the number of leaves
+        replayed."""
+        log_size = self.txn_store.size
+        if self.tree.tree_size > log_size:
+            # tree AHEAD of the log (crash between the tree persist and
+            # the log append): the LOG is still the truth — a root
+            # committing to a leaf the log doesn't contain would poison
+            # every proof served. Rebuild the tree from scratch.
+            self.tree.reset()
+        behind = log_size - self.tree.tree_size
+        if behind <= 0:
+            self.seq_no = self.tree.tree_size
+            return 0
+        for seq in range(self.tree.tree_size + 1, log_size + 1):
+            self.tree.append(self.txn_store.get(self._key(seq)))
+        self.seq_no = self.tree.tree_size
+        return behind
+
     def reset_to(self, size: int) -> None:
         """Truncate the committed log to ``size`` txns (diverged-node
         resync: everything past — or, for ``size=0``, the whole log — is

@@ -76,6 +76,9 @@ class LedgersBootstrap:
             ledger = Ledger(
                 tree=CompactMerkleTree(hash_store=self.storage.hash_stores[lid]),
                 txn_store=self.storage.txn_stores[lid])
+            # crash recovery: a lost/stale hash store rebuilds from the
+            # durable txn log (the log is the truth; the tree is derived)
+            ledger.recover_tree()
             state = None
             if lid in STATEFUL_LEDGERS:
                 state = SparseMerkleState(kv=self.storage.state_stores[lid])

@@ -345,7 +345,8 @@ class SimPool:
                  num_instances: int = 1,
                  mesh=None,
                  host_accounting: bool = False,
-                 pipelined_flush: bool = False):
+                 pipelined_flush: bool = False,
+                 spy: bool = False):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
@@ -457,6 +458,26 @@ class SimPool:
         # flush — conservative: a real node flushes only its own
         # num_instances-member plane) accumulates in host_seconds[name];
         # the busiest node bounds a deployed pool's throughput.
+        # spy instrumentation (reference: plenum/test/testable.py): every
+        # node's routers record (msg, sender, verdict, sim-time) — tests
+        # can assert exact processing counts, not just end states. Query
+        # via pool.spy_of(name, inst_id).
+        self._spies: Dict[tuple, object] = {}
+        if spy:
+            from ..common.stashing_router import RouterSpy
+
+            clock = self.timer.get_current_time
+            for nd in self.nodes:
+                for st, key in ((nd.stasher3pc, (nd.name, 0, "3pc")),
+                                (nd.stasher, (nd.name, 0, "other"))):
+                    st.spy = RouterSpy(clock=clock)
+                    self._spies[key] = st.spy
+                replicas = getattr(nd, "replicas", None)
+                for backup in (replicas.backups if replicas else ()):
+                    backup.stasher.spy = RouterSpy(clock=clock)
+                    self._spies[(nd.name, backup.inst_id, "3pc")] = \
+                        backup.stasher.spy
+
         self.host_seconds: Optional[Dict[str, float]] = None
         if host_accounting:
             self.host_seconds = {n.name: 0.0 for n in self.nodes}
@@ -504,6 +525,12 @@ class SimPool:
 
     def node(self, name: str) -> SimNode:
         return next(n for n in self.nodes if n.name == name)
+
+    def spy_of(self, name: str, inst_id: int = 0, router: str = "3pc"):
+        """The RouterSpy for ``name``'s instance router (pool built with
+        spy=True); ``router``: "3pc" (ordering/checkpoint traffic) or
+        "other" (view change / instance change / message req)."""
+        return self._spies[(name, inst_id, router)]
 
     @property
     def primary(self) -> SimNode:

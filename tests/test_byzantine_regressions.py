@@ -64,3 +64,81 @@ def test_byzantine_wrong_digest_prepare_cannot_block_honest_quorum():
     pool.run_for(10)
     assert len(node1.ordered_digests) == 1
     assert pool.honest_nodes_agree()
+
+
+def test_spy_duplicate_prepare_processed_once():
+    """Spy instrumentation (reference plenum/test/testable.py Spyable):
+    assert EXACT per-node processing facts, not just end states — a
+    duplicated PREPARE is processed once and DISCARDED the second time."""
+    from indy_plenum_tpu.common.stashing_router import DISCARD, PROCESS
+
+    pool = SimPool(4, seed=41, spy=True)
+    node1 = pool.node("node1")
+    pool.submit_request(0)
+    pool.run_for(8)
+    assert len(node1.ordered_digests) == 1
+    spy = pool.spy_of("node1")
+    # every honest non-primary peer's PREPARE was PROCESSED exactly once
+    primary = pool.nodes[0].data.primaries[0]
+    for peer in ("node2", "node3"):
+        if peer == primary or peer == "node1":
+            continue
+        assert spy.count(Prepare, frm=peer, verdict=PROCESS) == 1, peer
+    # replay one recorded PREPARE: the duplicate is DISCARDED, and the
+    # spy proves it was the DUPLICATE path (no second PROCESS event)
+    pp_events = spy.events(Prepare, verdict=PROCESS)
+    msg, frm, _v, _t = pp_events[0]
+    before = spy.count(Prepare, frm=frm, verdict=PROCESS)
+    node1.external_bus.process_incoming(msg, frm)
+    pool.run_for(1)
+    assert spy.count(Prepare, frm=frm, verdict=PROCESS) == before
+    assert spy.count(Prepare, frm=frm, verdict=DISCARD) >= 1
+
+
+def test_spy_forged_prepare_recorded_once_never_counted():
+    """The forged-early-PREPARE regression, restated as spy evidence:
+    the byzantine vote is RECORDED exactly once (the reference also
+    stores early prepares — the defence is digest filtering at cert
+    time), a REPLAY of it is DISCARDED as a duplicate, and the spy's
+    virtual-clock stamps prove the forge PRECEDED every honest vote yet
+    never inflated the certificate."""
+    from indy_plenum_tpu.common.stashing_router import DISCARD, PROCESS
+
+    pool = SimPool(4, seed=42, spy=True)
+    node1 = pool.node("node1")
+    evil = Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=1_700_000_000,
+                   digest="evil", stateRootHash=None, txnRootHash=None)
+    node1.external_bus.process_incoming(evil, "node3")
+    node1.external_bus.process_incoming(evil, "node3")  # replayed
+    pool.submit_request(0)
+    pool.run_for(8)
+    assert len(node1.ordered_digests) == 1
+    spy = pool.spy_of("node1")
+    evil_events = [e for e in spy.events(Prepare, frm="node3")
+                   if e[0].digest == "evil"]
+    assert [v for _m, _f, v, _t in evil_events] == [PROCESS, DISCARD]
+    honest = [e for e in spy.events(Prepare, verdict=PROCESS)
+              if e[0].digest != "evil"]
+    assert honest
+    # the forge preceded every honest vote on the virtual clock and was
+    # still never counted (ordering completed on the honest digest)
+    assert min(t for *_x, t in evil_events) <= min(
+        t for *_x, t in honest)
+
+
+def test_spy_other_instance_traffic_never_reaches_master_router():
+    """The round-5 instId demux: a backup instance's PREPARE must never
+    even REACH the master's 3PC router (pre-demux it arrived and was
+    discarded per instance — measured 22x handler amplification)."""
+    pool = SimPool(4, seed=43, num_instances=2, spy=True)
+    pool.submit_request(0)
+    pool.run_for(8)
+    assert pool.honest_nodes_agree()
+    for nd in pool.nodes:
+        master_spy = pool.spy_of(nd.name, 0)
+        assert all(getattr(m, "instId", 0) == 0
+                   for m, _f, _v, _t in master_spy.events(Prepare)), nd.name
+        backup_spy = pool.spy_of(nd.name, 1)
+        backup_prepares = backup_spy.events(Prepare)
+        assert backup_prepares, "backup instance saw no traffic"
+        assert all(m.instId == 1 for m, _f, _v, _t in backup_prepares)

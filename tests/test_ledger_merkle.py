@@ -114,3 +114,25 @@ def test_kv_iterator_order():
         assert [k for k, _ in kv.iterator(start=b"b")] == [b"b", b"c"]
         kv.do_batch([(b"d", b"4"), (b"a", None)])
         assert not kv.has_key(b"a") and kv.get(b"d") == b"4"
+
+
+def test_recover_tree_when_hash_store_ahead_of_log():
+    """Crash between tree persist and log append: the tree claims one
+    more leaf than the durable log holds. The LOG is the truth — the
+    tree must rebuild, never serve a root the log can't back."""
+    from indy_plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from indy_plenum_tpu.ledger.ledger import Ledger
+
+    led = Ledger()
+    for i in range(10):
+        led.add({"txn": {"type": "1", "data": {"v": i}},
+                 "txnMetadata": {}, "ver": "1"})
+    root10 = led.root_hash
+    # simulate the torn write: one extra leaf in the tree only
+    led.tree.append(b"phantom-leaf-not-in-the-log")
+    led.seq_no = led.tree.tree_size
+    assert led.tree.tree_size == 11 and led.txn_store.size == 10
+    replayed = led.recover_tree()
+    assert replayed == 10  # full rebuild from the log
+    assert led.size == 10 and led.root_hash == root10
+    assert led.get_by_seq_no(10) is not None

@@ -110,3 +110,18 @@ def test_config_overlay():
         getConfig({"NoSuchKey": 1})
     assert cfg.replicas_count(4) == 2  # f=1 -> master + 1 backup
     assert cfg.replicas_count(10) == 4
+
+
+def test_foreign_attributes_never_leak_into_wire_form():
+    """_values aliases the instance __dict__ (round-5 hot-path change);
+    a stray attribute forced in via object.__setattr__ must not leak
+    into as_dict/equality/hash — the wire form is the schema, period."""
+    from indy_plenum_tpu.common.messages.node_messages import Commit
+
+    a = Commit(instId=0, viewNo=0, ppSeqNo=1)
+    b = Commit(instId=0, viewNo=0, ppSeqNo=1)
+    object.__setattr__(a, "_smuggled", "x")
+    assert "_smuggled" not in a.as_dict()
+    assert a == b and hash(a) == hash(b)
+    # and the wire form round-trips cleanly
+    assert Commit.from_dict(a.as_dict()) == b

@@ -357,3 +357,122 @@ def test_chunked_store_meta_durability(tmp_path):
         fh.write("0")
     with pytest.raises(ValueError, match="corrupt chunk_size"):
         ChunkedFileStore(str(tmp_path), "log", chunk_size=4)
+
+
+def test_full_node_restart_soak_at_scale(tmp_path):
+    """Round-5 verdict item: the durable path soaked END-TO-END — a node
+    populated through the real execution stack over the chunked ledger
+    log + sqlite SMT stores, then RESTARTED with a lost hash store (the
+    worst honest crash: the tree must rebuild from the log), measuring
+    restart-to-participating wall-clock. Height >= 1M under
+    INDY_TPU_STRICT_BENCH; the default run covers the same code paths at
+    5k (CI-budget pass, same shapes).
+    """
+    import hashlib
+    import os
+    import time as _time
+
+    from indy_plenum_tpu.common.constants import (
+        DOMAIN_LEDGER_ID,
+        NYM,
+        TARGET_NYM,
+        TRUSTEE,
+        TXN_TYPE,
+        VERKEY,
+    )
+    from indy_plenum_tpu.common.request import Request
+    from indy_plenum_tpu.crypto.signers import DidSigner
+    from indy_plenum_tpu.ledger.genesis import genesis_nym_txn
+    from indy_plenum_tpu.ledger.hash_stores import MemoryHashStore
+    from indy_plenum_tpu.ledger.merkle_verifier import STH, MerkleVerifier
+    from indy_plenum_tpu.server.ledgers_bootstrap import (
+        LedgersBootstrap,
+        NodeStorage,
+    )
+    from indy_plenum_tpu.server.request_managers.write_request_manager import (
+        NodeExecutor,
+    )
+    from indy_plenum_tpu.storage.file_stores import ChunkedFileStore
+    from indy_plenum_tpu.storage.kv_store import KeyValueStorageSqlite
+    from indy_plenum_tpu.utils.base58 import b58encode
+
+    trustee = DidSigner(b"\x01" * 32)
+    genesis = [genesis_nym_txn(trustee.identifier, trustee.verkey,
+                               role=TRUSTEE)]
+
+    def make_storage():
+        storage = NodeStorage()
+        for lid in list(storage.txn_stores):
+            storage.txn_stores[lid] = ChunkedFileStore(
+                str(tmp_path), f"txns{lid}", chunk_size=100_000)
+        for lid in list(storage.state_stores):
+            storage.state_stores[lid] = KeyValueStorageSqlite(
+                str(tmp_path), f"state{lid}")
+        return storage
+
+    storage = make_storage()
+    boot = LedgersBootstrap(storage=storage,
+                            domain_genesis=genesis).build()
+    ex = NodeExecutor(boot.write_manager)
+    strict = bool(os.environ.get("INDY_TPU_STRICT_BENCH"))
+    n = 1_000_000 if strict else 5_000
+    batch = 1_000
+    seq = 0
+    t0 = _time.perf_counter()
+    for b in range(n // batch):
+        reqs = []
+        for _ in range(batch):
+            seq += 1
+            h = hashlib.sha256(b"soak%d" % seq).digest()
+            reqs.append(Request(
+                identifier=trustee.identifier, reqId=seq,
+                operation={TXN_TYPE: NYM, TARGET_NYM: b58encode(h[:16]),
+                           VERKEY: b58encode(h)}))
+        ex.apply_batch(reqs, DOMAIN_LEDGER_ID, 1_700_000_000 + b, b + 1)
+        ex.commit_batch(b + 1)
+    build_s = _time.perf_counter() - t0
+    domain = boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    pre_state_root = boot.db.get_state(
+        DOMAIN_LEDGER_ID).committed_head_hash
+    pre_txn_root = domain.root_hash
+    assert domain.size == n + 1  # + genesis nym
+    height = boot.committed_pp_seq_no
+
+    # RESTART with the hash stores LOST: the tree must rebuild from the
+    # chunked log; states reopen from sqlite; audit spine pins the height
+    storage.hash_stores = {lid: MemoryHashStore()
+                           for lid in storage.hash_stores}
+    t0 = _time.perf_counter()
+    boot2 = LedgersBootstrap(storage=storage,
+                             domain_genesis=genesis).build()
+    assert boot2.committed_pp_seq_no == height
+    domain2 = boot2.db.get_ledger(DOMAIN_LEDGER_ID)
+    assert domain2.size == n + 1
+    assert domain2.root_hash == pre_txn_root  # tree REBUILT from the log
+    assert boot2.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash \
+        == pre_state_root
+    # participating: serves committed reads AND audit-path proofs
+    probe = hashlib.sha256(b"soak%d" % (n // 2)).digest()
+    assert boot2.nym_handler.get_nym_data(
+        b58encode(probe[:16]), is_committed=True) is not None
+    leaf_seq = n // 2
+    path = domain2.audit_path(leaf_seq, domain2.size)  # 1-based seq
+    raw = domain2.txn_store.get(domain2._key(leaf_seq))
+    sth = STH(tree_size=domain2.size, sha256_root_hash=pre_txn_root)
+    assert MerkleVerifier().verify_leaf_inclusion(
+        raw, leaf_seq - 1, path, sth)
+    # ... and keeps executing from the recovered height
+    ex2 = NodeExecutor(boot2.write_manager)
+    seq += 1
+    h = hashlib.sha256(b"soak%d" % seq).digest()
+    ex2.apply_batch([Request(
+        identifier=trustee.identifier, reqId=seq,
+        operation={TXN_TYPE: NYM, TARGET_NYM: b58encode(h[:16]),
+                   VERKEY: b58encode(h)})],
+        DOMAIN_LEDGER_ID, 1_700_100_000, height + 1)
+    ex2.commit_batch(height + 1)
+    assert boot2.db.get_ledger(DOMAIN_LEDGER_ID).size == n + 2
+    restart_s = _time.perf_counter() - t0
+    print(f"\nsoak: populated {n} txns in {build_s:.1f}s "
+          f"({n / build_s:,.0f}/s); restart-to-participating "
+          f"(hash store lost, tree rebuilt) {restart_s:.2f}s")

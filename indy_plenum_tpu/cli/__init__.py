@@ -1,0 +1,1 @@
+from .cli import PoolCli, main  # noqa: F401

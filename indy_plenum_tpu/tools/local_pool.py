@@ -239,3 +239,23 @@ def build_client(directory: str, name: str = "client1",
     stack.on_message = client.process_node_message
     client.stack = stack
     return client, stack
+
+
+def warm_verify_kernel(node, signer) -> None:
+    """Compile the signature-verify kernel shapes BEFORE real traffic:
+    the first XLA compile costs tens of seconds (minutes on a remote
+    device) and would otherwise eat a write's quorum timeout. One
+    definition for the CLI and test fixtures — the jit cache is shared
+    process-wide, so warming any one node warms them all."""
+    import hashlib
+
+    from ..common.constants import NYM, TARGET_NYM, TXN_TYPE, VERKEY
+    from ..common.request import Request
+    from ..crypto.signers import DidSigner
+
+    probe = DidSigner(hashlib.sha256(b"warm-verify-kernel").digest())
+    req = Request(identifier=signer.identifier, reqId=1,
+                  operation={TXN_TYPE: NYM, TARGET_NYM: probe.identifier,
+                             VERKEY: probe.verkey})
+    signer.sign_request(req)
+    node.authnr.authenticate_batch([req])

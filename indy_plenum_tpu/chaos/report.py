@@ -1,0 +1,97 @@
+"""Reproducible chaos-run reports.
+
+A run's full forensic record as JSON: the seed and scenario (everything
+needed to replay it exactly), the compiled fault plan, the virtual-time
+event trace, network delivery accounting, pool metrics, per-node ordering
+state and every invariant verdict. A failing run's report IS its repro —
+``replay_command`` re-executes the identical schedule.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class ChaosReport:
+    scenario: str
+    seed: int
+    n_nodes: int
+    plan: List[Dict[str, Any]]
+    trace: List[Tuple[float, str]]
+    invariants: List[Dict[str, Any]]
+    expected_failures: List[str]
+    network: Dict[str, Any] = field(default_factory=dict)
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    ordered_per_node: Dict[str, int] = field(default_factory=dict)
+    # RBFT monitor views, for pools whose nodes carry one (NodePool)
+    monitor_per_node: Dict[str, Any] = field(default_factory=dict)
+    byzantine_nodes: List[str] = field(default_factory=list)
+    periodic_checks: int = 0
+    first_violation: Optional[Tuple[float, str]] = None
+    virtual_seconds: float = 0.0
+
+    @property
+    def failed(self) -> List[str]:
+        return [r["name"] for r in self.invariants
+                if r["verdict"] != "PASS"]
+
+    @property
+    def verdict_as_expected(self) -> bool:
+        """True when exactly the designed-to-fail invariants failed —
+        the pass criterion for scenarios proving the checker non-vacuous."""
+        return sorted(self.failed) == sorted(self.expected_failures)
+
+    @property
+    def replay_command(self) -> str:
+        return (f"python scripts/chaos_run.py --seed {self.seed} "
+                f"--scenario {self.scenario} --nodes {self.n_nodes}")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "n_nodes": self.n_nodes,
+            "replay_command": self.replay_command,
+            "verdict_as_expected": self.verdict_as_expected,
+            "invariants": self.invariants,
+            "expected_failures": list(self.expected_failures),
+            "byzantine_nodes": list(self.byzantine_nodes),
+            "plan": self.plan,
+            "trace": [[t, e] for t, e in self.trace],
+            "network": self.network,
+            "metrics": self.metrics,
+            "ordered_per_node": self.ordered_per_node,
+            "monitor_per_node": self.monitor_per_node,
+            "periodic_checks": self.periodic_checks,
+            "first_violation": (list(self.first_violation)
+                                if self.first_violation else None),
+            "virtual_seconds": self.virtual_seconds,
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.as_dict(), indent=indent, sort_keys=False)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as fh:
+            fh.write(self.to_json() + "\n")
+        return path
+
+    def summary_lines(self) -> List[str]:
+        lines = [f"scenario={self.scenario} seed={self.seed} "
+                 f"nodes={self.n_nodes} "
+                 f"virtual={self.virtual_seconds:.0f}s"]
+        for r in self.invariants:
+            mark = "PASS" if r["verdict"] == "PASS" else "FAIL"
+            lines.append(f"  [{mark}] {r['name']}: {r['detail']}")
+        net = self.network
+        lines.append(
+            f"  network: sent={net.get('sent')} "
+            f"dropped={net.get('dropped')} "
+            f"duplicated={net.get('duplicated')}")
+        if self.first_violation is not None:
+            t, what = self.first_violation
+            lines.append(f"  first violation at t={t:.2f}: {what}")
+        lines.append(f"  replay: {self.replay_command}")
+        return lines

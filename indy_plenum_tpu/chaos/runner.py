@@ -1,0 +1,102 @@
+"""Scenario runner: pool up, plan in, invariants out, report saved.
+
+One call drives the whole chaos loop deterministically on the virtual
+clock: build a :class:`SimPool`, compile the scenario's seeded
+:class:`FaultPlan` onto its timer, feed client traffic, run past the last
+bounded fault, then hand the pool to the
+:class:`~indy_plenum_tpu.chaos.invariants.InvariantChecker` (safety
+continuously during the run via the scheduler's probe, safety + liveness
+at the end) and emit a replayable :class:`ChaosReport`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import getConfig
+from ..simulation.pool import SimPool
+from .invariants import InvariantChecker
+from .report import ChaosReport
+from .scenarios import Scenario, get_scenario
+from .scheduler import FaultScheduler
+
+# the simulation-friendly protocol tunables every scenario starts from;
+# scenario config_overrides layer on top
+BASE_CONFIG = {
+    "Max3PCBatchWait": 0.1,
+    "Max3PCBatchSize": 5,
+    # keep the WHOLE run inside one checkpoint window: plain SimPool has
+    # no ledger catchup, so a replica that falls behind a stabilized
+    # checkpoint could never re-sync — recovery during chaos runs rides
+    # 3PC re-request + NEW_VIEW re-ordering, both of which need peers to
+    # still hold the logs
+    "CHK_FREQ": 50,
+    "LOG_SIZE": 150,
+    # tight PBFT stall timer: chaos runs stall pools on purpose and the
+    # recovery path (stall votes -> view change -> re-propose) is exactly
+    # what the liveness invariant exercises
+    "OrderingStallTimeout": 4.0,
+}
+
+
+def run_scenario(scenario: "str | Scenario", seed: int,
+                 n_nodes: int = 0,
+                 out_path: Optional[str] = None,
+                 probe_interval: float = 1.0) -> ChaosReport:
+    if isinstance(scenario, str):
+        scenario = get_scenario(scenario)
+    n = n_nodes or scenario.n_nodes
+    plan = scenario.plan(seed, n)
+
+    config = getConfig({**BASE_CONFIG, **scenario.config_overrides})
+    pool = SimPool(n_nodes=n, seed=seed, config=config)
+    checker = InvariantChecker(
+        pool,
+        byzantine=plan.byzantine_nodes,
+        crashed=plan.crashed_forever_nodes)
+    scheduler = FaultScheduler(
+        pool, plan,
+        safety_probe=checker.check_safety,
+        probe_interval=probe_interval).install()
+
+    # client traffic from t=0, plus a steady trickle across the fault
+    # window so crashes/partitions hit in-flight ordering
+    for i in range(scenario.initial_requests):
+        pool.submit_request(i)
+    for i in range(scenario.trickle_requests):
+        pool.timer.schedule(
+            (i + 1) * scenario.trickle_interval,
+            lambda seq=scenario.initial_requests + i:
+            pool.submit_request(seq))
+
+    # run past the last bounded fault, then let the pool settle
+    horizon = max(scenario.run_seconds, plan.end_time + 5.0)
+    pool.run_for(horizon)
+    scheduler.stop_probe()
+
+    results = checker.check_all(
+        probes=3, liveness_timeout=scenario.liveness_timeout)
+
+    report = ChaosReport(
+        scenario=scenario.name,
+        seed=seed,
+        n_nodes=n,
+        plan=plan.as_dicts(),
+        trace=list(scheduler.trace),
+        invariants=[r.as_dict() for r in results],
+        expected_failures=list(scenario.expect_fail),
+        network=pool.network.counters(),
+        metrics=pool.metrics.summary(),
+        ordered_per_node={nd.name: len(nd.ordered_digests)
+                          for nd in pool.nodes},
+        monitor_per_node={
+            nd.name: nd.monitor.snapshot() for nd in pool.nodes
+            if getattr(nd, "monitor", None) is not None},
+        byzantine_nodes=sorted(plan.byzantine_nodes),
+        periodic_checks=len(scheduler.probe_results),
+        first_violation=scheduler.first_violation,
+        virtual_seconds=pool.timer.get_current_time()
+        - 1_700_000_000.0,
+    )
+    if out_path is not None:
+        report.save(out_path)
+    return report

@@ -1,0 +1,254 @@
+"""Named chaos scenarios: seed -> FaultPlan generators.
+
+Each scenario is a recipe that expands ``(seed, n_nodes)`` into a
+concrete :class:`FaultPlan` through ONE ``random.Random(seed)`` — victim
+selection, fault timing and probabilities are all drawn from it, so a
+scenario replays exactly from its seed (the whole point of the chaos
+plane: any red run is a repro, not an anecdote).
+
+``expect_fail`` names invariants a scenario is DESIGNED to violate — the
+checker-vacuity proof (``broken_agreement``) must fail agreement, and a
+runner treats exactly those failures as the expected outcome.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Tuple
+
+from .faults import (
+    ClockSkewFault,
+    CorruptOrderedLogFault,
+    CrashFault,
+    DelayFault,
+    DropFault,
+    DuplicateFault,
+    EquivocateFault,
+    FaultPlan,
+    PartitionFault,
+    ReorderFault,
+    SilenceFault,
+)
+
+THREE_PC_TYPES = ("PrePrepare", "Prepare", "Commit")
+
+
+@dataclass
+class Scenario:
+    name: str
+    build: Callable[[random.Random, List[str]], List]
+    description: str = ""
+    n_nodes: int = 4
+    initial_requests: int = 8
+    # a steady client trickle keeps work in flight while faults are
+    # active, so crashes/partitions hit mid-protocol, not an idle pool
+    trickle_requests: int = 12
+    trickle_interval: float = 1.5
+    run_seconds: float = 30.0
+    liveness_timeout: float = 40.0
+    expect_fail: Tuple[str, ...] = ()
+    config_overrides: Dict = field(default_factory=dict)
+
+    def plan(self, seed: int, n_nodes: int = 0) -> FaultPlan:
+        n = n_nodes or self.n_nodes
+        validators = [f"node{i}" for i in range(n)]
+        rng = random.Random(seed)
+        return FaultPlan(seed=seed, faults=self.build(rng, validators))
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def register(scenario: Scenario) -> Scenario:
+    SCENARIOS[scenario.name] = scenario
+    return scenario
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown chaos scenario {name!r}; known: "
+            f"{', '.join(sorted(SCENARIOS))}") from None
+
+
+def _split(validators: List[str], rng: random.Random
+           ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """An rng-chosen ~half/half partition of the pool."""
+    shuffled = list(validators)
+    rng.shuffle(shuffled)
+    cut = len(shuffled) // 2
+    return tuple(shuffled[:cut]), tuple(shuffled[cut:])
+
+
+# --- the acceptance scenario: f crashes + a partition that heals ---------
+
+def _f_crash_partition(rng: random.Random, validators: List[str]) -> List:
+    f = (len(validators) - 1) // 3
+    # crash f non-primary nodes (staggered, all restart): the pool keeps
+    # ordering on the remaining n-f quorum, and the restarted nodes must
+    # re-join. node0 is the view-0 primary under the round-robin selector.
+    victims = rng.sample(validators[1:], f)
+    faults: List = [
+        CrashFault(node=victim, at=2.0 + 2.0 * i, duration=6.0)
+        for i, victim in enumerate(victims)]
+    # then a clean ~half/half partition: no side may have a commit quorum,
+    # ordering stalls, and the heal must bring progress back
+    groups = _split(validators, rng)
+    faults.append(PartitionFault(groups=groups, at=14.0, duration=6.0))
+    return faults
+
+
+register(Scenario(
+    name="f_crash_partition",
+    build=_f_crash_partition,
+    description="f staggered crash/restarts, then a half/half partition "
+                "that heals; all invariants must hold",
+    run_seconds=30.0))
+
+
+# --- single-primitive scenarios (each fault class in isolation) ----------
+
+def _crash_restart(rng: random.Random, validators: List[str]) -> List:
+    victim = rng.choice(validators)  # may be the primary: exercises VC
+    return [CrashFault(node=victim, at=2.0, duration=8.0)]
+
+
+register(Scenario(
+    name="crash_restart",
+    build=_crash_restart,
+    description="one node (possibly the primary) fail-stops and restarts",
+    run_seconds=25.0))
+
+
+def _partition_heal(rng: random.Random, validators: List[str]) -> List:
+    return [PartitionFault(groups=_split(validators, rng),
+                           at=3.0, duration=8.0)]
+
+
+register(Scenario(
+    name="partition_heal",
+    build=_partition_heal,
+    description="half/half partition for 8s, then heal",
+    run_seconds=25.0))
+
+
+def _flaky_links(rng: random.Random, validators: List[str]) -> List:
+    # probabilistic 3PC message loss on the whole mesh — below the drop
+    # rate that starves a quorum, ordering must still make progress
+    return [DropFault(types=THREE_PC_TYPES, probability=0.15,
+                      at=2.0, duration=10.0)]
+
+
+register(Scenario(
+    name="flaky_links",
+    build=_flaky_links,
+    description="15% seeded loss on all 3PC traffic for 10s",
+    run_seconds=30.0))
+
+
+def _dup_reorder(rng: random.Random, validators: List[str]) -> List:
+    # at-least-once + out-of-order delivery: vote collection must be
+    # idempotent and order-insensitive
+    return [
+        DuplicateFault(types=THREE_PC_TYPES, copies=3, gap=0.07,
+                       at=1.0, duration=10.0),
+        ReorderFault(types=THREE_PC_TYPES, jitter=0.4,
+                     at=1.0, duration=10.0),
+    ]
+
+
+register(Scenario(
+    name="dup_reorder",
+    build=_dup_reorder,
+    description="3PC messages delivered 3x with 0.4s reorder jitter",
+    run_seconds=25.0))
+
+
+def _clock_skew(rng: random.Random, validators: List[str]) -> List:
+    victim = rng.choice(validators[1:])
+    return [ClockSkewFault(node=victim, skew=0.6, at=2.0, duration=10.0),
+            DelayFault(frm=victim, seconds=0.3, at=2.0, duration=10.0)]
+
+
+register(Scenario(
+    name="clock_skew",
+    build=_clock_skew,
+    description="one replica runs 0.6s behind the pool (plus slow uplink)",
+    run_seconds=25.0))
+
+
+def _silent_primary(rng: random.Random, validators: List[str]) -> List:
+    # byzantine silence, bounded: the primary withholds PRE-PREPAREs for a
+    # while (slow-but-alive byzantine); ordering must resume after
+    return [SilenceFault(node=validators[0], types=("PrePrepare",),
+                         at=2.0, duration=6.0)]
+
+
+register(Scenario(
+    name="silent_primary",
+    build=_silent_primary,
+    description="primary withholds PRE-PREPAREs for 6s, then behaves",
+    run_seconds=25.0))
+
+
+def _equivocating_primary(rng: random.Random, validators: List[str]) -> List:
+    # permanent equivocation by the view-0 primary: conflicting digests
+    # can never gather a prepare quorum, suspicion evidence votes the
+    # primary out, and the HONEST pool must stay consistent and live
+    return [EquivocateFault(node=validators[0], at=1.0)]
+
+
+register(Scenario(
+    name="equivocating_primary",
+    build=_equivocating_primary,
+    description="primary sends per-recipient forged PRE-PREPARE digests "
+                "until voted out",
+    run_seconds=45.0,
+    liveness_timeout=60.0))
+
+
+def _storm(rng: random.Random, validators: List[str]) -> List:
+    # everything at once, long horizon: crashes, loss, duplication,
+    # reorder, skew — the 'as many scenarios as you can imagine' soak
+    faults: List = [
+        DropFault(types=THREE_PC_TYPES, probability=0.1,
+                  at=1.0, duration=25.0),
+        DuplicateFault(copies=2, gap=0.05, at=1.0, duration=25.0),
+        ReorderFault(jitter=0.3, at=1.0, duration=25.0),
+    ]
+    f = (len(validators) - 1) // 3
+    for i, victim in enumerate(rng.sample(validators[1:], f)):
+        faults.append(CrashFault(node=victim, at=4.0 + 3.0 * i,
+                                 duration=5.0))
+        faults.append(ClockSkewFault(node=victim, skew=0.4,
+                                     at=12.0 + 2.0 * i, duration=6.0))
+    return faults
+
+
+register(Scenario(
+    name="storm",
+    build=_storm,
+    description="25s soak: loss + duplication + reorder + crashes + skew",
+    run_seconds=60.0,
+    liveness_timeout=60.0,
+    initial_requests=16))
+
+
+# --- the checker-vacuity proof -------------------------------------------
+
+def _broken_agreement(rng: random.Random, validators: List[str]) -> List:
+    # an 'undetectable' state-corruption bug on an honest replica: the
+    # agreement invariant MUST flag it, or the checker is vacuous
+    victim = rng.choice(validators[1:])
+    return [CorruptOrderedLogFault(node=victim, at=6.0)]
+
+
+register(Scenario(
+    name="broken_agreement",
+    build=_broken_agreement,
+    description="deliberately corrupt one honest replica's executed log; "
+                "the agreement invariant must FAIL",
+    run_seconds=12.0,
+    expect_fail=("agreement", "ordered_prefix")))

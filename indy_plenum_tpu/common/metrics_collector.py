@@ -35,6 +35,10 @@ class MetricsName:
     CATCHUP_FAILED = "catchup.failed"
     # transport
     ZSTACK_DROPPED = "zstack.dropped"
+    # simulation network / chaos plane
+    SIM_NET_DELIVERED = "sim_net.delivered"
+    SIM_NET_DROPPED = "sim_net.dropped"
+    CHAOS_FAULTS_BEGUN = "chaos.faults_begun"
 
 
 class Stat:

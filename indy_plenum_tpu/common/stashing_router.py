@@ -151,9 +151,14 @@ class StashingRouter(Router):
         """Replay everything stashed under ``reason``; returns count replayed."""
         queue = self._queues[reason]
         processed = 0
-        # Bound the replay to the current length: re-stashed messages must
-        # not cause an infinite loop within one call.
-        for _ in range(len(queue)):
+        # Bound the replay to the entry length: re-stashed messages must
+        # not cause an infinite loop within one call. Re-check emptiness
+        # every iteration — processing a message can REENTER
+        # process_stashed for the same reason (e.g. a fetched old-view
+        # PRE-PREPARE unstashes its successor, which unstashes further)
+        # and drain the queue under this loop.
+        bound = len(queue)
+        while processed < bound and queue:
             message, args = queue.popleft()
             self.process(message, *args)
             processed += 1

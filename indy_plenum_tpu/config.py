@@ -49,6 +49,12 @@ class Config:
     OldViewPPRequestInterval: float = 1.0  # re-fetch missing old-view PPs
     NewViewTimeout: float = 30.0  # restart VC with v+1 if not completed
     ViewChangeResendInterval: float = 10.0
+    # the canonical PBFT liveness timer (Castro & Liskov §4.5.2): a master
+    # replica with work pending but no ordering progress across a full
+    # interval votes INSTANCE_CHANGE (detection latency is 1-2 intervals;
+    # 0 disables). Recovers from in-flight 3PC messages lost for good —
+    # e.g. after a partition heals — which no retransmit path covers.
+    OrderingStallTimeout: float = 12.0
     INSTANCE_CHANGE_TIMEOUT: float = 300.0  # discard stale instance changes
 
     # --- catchup ----------------------------------------------------------

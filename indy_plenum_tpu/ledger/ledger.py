@@ -138,7 +138,13 @@ class Ledger:
             # tree AHEAD of the log (crash between the tree persist and
             # the log append): the LOG is still the truth — a root
             # committing to a leaf the log doesn't contain would poison
-            # every proof served. Rebuild the tree from scratch.
+            # every proof served. Rebuild the tree from scratch — hash
+            # store FIRST (CompactMerkleTree.reset leaves persistence to
+            # the caller): a surviving leaf_count key would reload the
+            # stale oversized tree on every restart, and orphaned
+            # leaf/node entries would linger in durable storage.
+            if self.tree.hash_store is not None:
+                self.tree.hash_store.reset()
             self.tree.reset()
         behind = log_size - self.tree.tree_size
         if behind <= 0:

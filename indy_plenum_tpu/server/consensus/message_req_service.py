@@ -127,7 +127,11 @@ class MessageReqService:
         key = self._key_from(params)
         if key is None or self._ordering is None:
             return None
-        return self._ordering.prePrepares.get(key)
+        # the primary keeps its OWN batches in sent_preprepares, not
+        # prePrepares — and PRE-PREPARE requests go only to the primary,
+        # so that log is the one that matters for a straggler's re-sync
+        return (self._ordering.prePrepares.get(key)
+                or self._ordering.sent_preprepares.get(key))
 
     def _find_prepare(self, params):
         key = self._key_from(params)

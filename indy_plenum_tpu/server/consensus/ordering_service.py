@@ -34,6 +34,7 @@ from ...common.messages.internal_messages import (
     RaisedSuspicion,
     RequestPropagates,
     ViewChangeStarted,
+    VoteForViewChange,
 )
 from ...common.messages.node_messages import (
     Commit,
@@ -228,6 +229,19 @@ class OrderingService:
         self._fetch_timer = RepeatingTimer(
             timer, self._config.OldViewPPRequestInterval,
             self._refetch_pending_old_view_pps, active=False)
+        # the canonical PBFT liveness timer (Castro & Liskov §4.5.2): with
+        # requests pending (or batches in flight) and NO ordering progress
+        # across a full interval, vote for a view change. This is what
+        # recovers a pool whose in-flight 3PC messages were lost for good
+        # (partition heal, crashed links) — nobody retransmits them; the
+        # new view re-proposes and stragglers fetch. Votes repeat while
+        # the stall persists, so votes lost IN the partition don't matter.
+        self._stall_snapshot: Optional[Tuple[int, Tuple[int, int]]] = None
+        self._stall_timer = None
+        if getattr(self._config, "OrderingStallTimeout", 0) > 0:
+            self._stall_timer = RepeatingTimer(
+                timer, self._config.OrderingStallTimeout,
+                self._on_stall_check, active=False)
 
     # ------------------------------------------------------------------
     # primary: batch creation
@@ -235,9 +249,79 @@ class OrderingService:
 
     def start(self) -> None:
         self._batch_timer.start()
+        if self._stall_timer is not None and self._is_master:
+            self._stall_timer.start()
 
     def stop(self) -> None:
         self._batch_timer.stop()
+        if self._stall_timer is not None:
+            self._stall_timer.stop()
+
+    # --- ordering-stall watchdog ---------------------------------------
+
+    def _on_stall_check(self) -> None:
+        if (not self._is_master or self._data.waiting_for_new_view
+                or not self._data.is_participating):
+            self._stall_snapshot = None
+            return
+        pending = ((self._requests is not None
+                    and bool(self._requests.ledger_ids_with_ready()))
+                   # in-flight batches count as pending work on replicas
+                   # (prePrepares) AND on the primary itself, whose own
+                   # unacked batches live in sent_preprepares
+                   or any(key not in self.ordered
+                          for key in self.prePrepares)
+                   or any(key not in self.ordered
+                          for key in self.sent_preprepares))
+        if not pending:
+            self._stall_snapshot = None
+            return
+        marker = (self._data.view_no, self._data.last_ordered_3pc)
+        if self._stall_snapshot == marker:
+            logger.info("%s: no ordering progress for %.1fs with work "
+                        "pending -> vote view change", self.name,
+                        self._config.OrderingStallTimeout)
+            # reset so the NEXT vote needs two more stalled checks — the
+            # repeat cadence that survives votes lost mid-partition
+            # without spamming an instance change every interval
+            self._stall_snapshot = None
+            self._bus.send(VoteForViewChange(
+                suspicion=Suspicions.ORDERING_STALLED))
+            return
+        self._stall_snapshot = marker
+        # before escalating: try a cheap self-heal. A replica that missed
+        # in-flight 3PC messages for good (partition, crash window) can
+        # re-request them — peers keep everything above the stable
+        # checkpoint, and each response re-enters the normal validated
+        # processing path. A pool-wide outage still escalates to the vote
+        # above; a single straggler resyncs without disturbing the view.
+        self._rerequest_inflight_3pc()
+
+    def _rerequest_inflight_3pc(self) -> None:
+        view_no = self._data.view_no
+        last_seq = self._data.last_ordered_3pc[1]
+        seen = (set(self.prePrepares) | set(self.sent_preprepares)
+                | set(self.prepares) | set(self.commits))
+        hi = max((seq for v, seq in seen if v == view_no),
+                 default=last_seq)
+        hi = min(hi, last_seq + self._data.log_size)
+        for seq in range(last_seq + 1, hi + 1):
+            key = (view_no, seq)
+            if key in self.ordered:
+                continue
+            if key not in self.prePrepares \
+                    and key not in self.sent_preprepares:
+                # dst resolution sends this to the primary only (the one
+                # authoritative author of a PRE-PREPARE)
+                self._bus.send(MissingMessage(
+                    msg_type="PREPREPARE", key=key,
+                    inst_id=self._data.inst_id, dst=None))
+            self._bus.send(MissingMessage(
+                msg_type="PREPARE", key=key,
+                inst_id=self._data.inst_id, dst=None))
+            self._bus.send(MissingMessage(
+                msg_type="COMMIT", key=key,
+                inst_id=self._data.inst_id, dst=None))
 
     # --- tick-batched quorum evaluation --------------------------------
 

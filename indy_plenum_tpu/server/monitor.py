@@ -117,6 +117,18 @@ class Monitor:
     # judging
     # ------------------------------------------------------------------
 
+    def snapshot(self) -> Dict[str, object]:
+        """Observability view for reports (chaos runs, diagnostics):
+        per-instance throughput, the master/backup ratio the Delta check
+        judges, and how often this node voted the master degraded."""
+        now = self._timer.get_current_time()
+        return {
+            "throughput_per_instance": [
+                t.get_throughput(now) for t in self._throughputs],
+            "master_throughput_ratio": self.master_throughput_ratio(),
+            "degradation_votes": self.degradation_votes,
+        }
+
     def master_throughput_ratio(self) -> Optional[float]:
         if len(self._throughputs) < 2:
             return None

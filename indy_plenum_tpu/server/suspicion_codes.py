@@ -45,6 +45,9 @@ class Suspicions:
         26, "master primary left the validator set (NODE txn demotion)")
     PRIMARY_DISCONNECTED = Suspicion(
         27, "primary unreachable past ToleratePrimaryDisconnection")
+    ORDERING_STALLED = Suspicion(
+        28, "no ordering progress with requests pending "
+            "(PBFT liveness timer expired)")
     SEQ_NO_OLD = Suspicion(30, "3PC message below watermark")
     SEQ_NO_FUTURE = Suspicion(31, "3PC message above watermark")
     CATCHUP_REP_WRONG = Suspicion(40, "CATCHUP_REP txns fail audit proof")

@@ -14,6 +14,7 @@ import hashlib
 from typing import Dict, List, Optional
 
 from ..common.constants import TRUSTEE
+from ..common.metrics_collector import MetricsCollector
 from ..common.request import Request
 from ..config import Config, getConfig
 from ..crypto.signers import DidSigner
@@ -35,7 +36,9 @@ class NodePool:
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
              "PropagateBatchWait": 0.05})
         self.timer = MockTimer(start_time=1_700_000_000.0)
-        self.network = SimNetwork(self.timer, seed=seed)
+        self.metrics = MetricsCollector()
+        self.network = SimNetwork(self.timer, seed=seed,
+                                  metrics=self.metrics)
         self.validators = [f"node{i}" for i in range(n_nodes)]
 
         self.trustee = DidSigner(b"\x09" * 32)

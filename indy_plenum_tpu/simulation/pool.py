@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from ..common.constants import DOMAIN_LEDGER_ID
 from ..common.event_bus import InternalBus
+from ..common.metrics_collector import MetricsCollector
 from ..common.messages.node_messages import Ordered
 from ..common.request import Request
 from ..common.stashing_router import StashingRouter
@@ -350,7 +351,9 @@ class SimPool:
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
-        self.network = SimNetwork(self.timer, seed=seed)
+        self.metrics = MetricsCollector()
+        self.network = SimNetwork(self.timer, seed=seed,
+                                  metrics=self.metrics)
         self.validators = [f"node{i}" for i in range(n_nodes)]
         # RBFT: f+1 parallel protocol instances (0 = auto f+1); backup
         # instances get their own finalised-request queue per (node, inst)

@@ -4,20 +4,27 @@ Reference: plenum/test/simulation/ (sim_network, sim_random) and the
 delayer mechanism of plenum/test/delayers.py. Messages between nodes are
 delivered through the shared :class:`MockTimer` with configurable
 (seeded-random or fixed) latency; *delayers* are predicates that can hold
-back or drop specific message types from specific senders — the fault
-injector for partitions, slow links and byzantine silence.
+back, drop or fan out specific message types from specific senders — the
+fault-injection surface the chaos plane
+(:mod:`indy_plenum_tpu.chaos`) compiles :class:`FaultPlan` primitives
+onto (partitions, slow links, duplication, reorder, byzantine silence).
 """
 from __future__ import annotations
 
 import random
-from typing import Any, Callable, Dict, List, Optional
+from collections import Counter
+from typing import Any, Callable, Dict, Optional, Sequence, Union
 
 from ..common.event_bus import ExternalBus
+from ..common.metrics_collector import MetricsCollector, MetricsName
 from .mock_timer import MockTimer
 
-# a delayer: (msg, frm, to) -> Optional[float]; None = deliver normally,
-# float = extra delay seconds, float('inf') = drop
-Delayer = Callable[[Any, str, str], Optional[float]]
+# a delayer: (msg, frm, to) -> None | float | sequence of floats.
+# None = no opinion; float = extra delay seconds; float('inf') = drop;
+# a sequence = deliver ONE COPY PER ENTRY offset by that many seconds
+# (duplication — the at-least-once transport chaos scenarios exercise).
+Delayer = Callable[[Any, str, str],
+                   Union[None, float, Sequence[float]]]
 
 
 def delay_message_types(*types, frm: Optional[str] = None,
@@ -39,15 +46,22 @@ def delay_message_types(*types, frm: Optional[str] = None,
 
 class SimNetwork:
     def __init__(self, timer: MockTimer, seed: int = 0,
-                 min_latency: float = 0.01, max_latency: float = 0.05):
+                 min_latency: float = 0.01, max_latency: float = 0.05,
+                 metrics: Optional[MetricsCollector] = None):
         self._timer = timer
         self._rng = random.Random(seed)
         self._min_latency = min_latency
         self._max_latency = max_latency
         self._peers: Dict[str, ExternalBus] = {}
-        self._delayers: List[Delayer] = []
+        self._delayers: list[Delayer] = []
+        self._metrics = metrics
         self.dropped = 0
         self.sent = 0
+        self.duplicated = 0
+        # per-message-type delivery accounting (chaos reports: which
+        # traffic a fault plan actually cut)
+        self.sent_by_type: Counter = Counter()
+        self.dropped_by_type: Counter = Counter()
 
     # --- wiring ---------------------------------------------------------
 
@@ -80,6 +94,13 @@ class SimNetwork:
     def reset_delays(self) -> None:
         self._delayers.clear()
 
+    def counters(self) -> Dict[str, Any]:
+        """Delivery accounting snapshot (chaos report / diagnostics)."""
+        return {"sent": self.sent, "dropped": self.dropped,
+                "duplicated": self.duplicated,
+                "sent_by_type": dict(self.sent_by_type),
+                "dropped_by_type": dict(self.dropped_by_type)}
+
     # --- delivery -------------------------------------------------------
 
     def _make_send_handler(self, frm: str):
@@ -95,23 +116,40 @@ class SimNetwork:
 
         return send
 
+    def _count_drop(self, msg) -> None:
+        self.dropped += 1
+        self.dropped_by_type[type(msg).__name__] += 1
+        if self._metrics is not None:
+            self._metrics.add_event(MetricsName.SIM_NET_DROPPED)
+
     def _deliver_later(self, msg, frm: str, to: str) -> None:
         if to not in self._peers:
             return
         # link must be up (receiver sees sender as connected)
         if not self._peers[to].is_connected(frm):
-            self.dropped += 1
+            self._count_drop(msg)
             return
         latency = self._rng.uniform(self._min_latency, self._max_latency)
+        offsets = [0.0]  # one entry per copy that will be delivered
         for delayer in list(self._delayers):
             extra = delayer(msg, frm, to)
             if extra is None:
                 continue
+            if isinstance(extra, (tuple, list)):
+                offsets = [o + e for o in offsets for e in extra]
+                continue
             if extra == float("inf"):
-                self.dropped += 1
+                self._count_drop(msg)
                 return
-            latency += extra
-        self.sent += 1
+            offsets = [o + extra for o in offsets]
+        self.sent += len(offsets)
+        self.duplicated += len(offsets) - 1
+        self.sent_by_type[type(msg).__name__] += len(offsets)
+        if self._metrics is not None:
+            self._metrics.add_event(MetricsName.SIM_NET_DELIVERED,
+                                    len(offsets))
         bus = self._peers[to]
-        self._timer.schedule(latency,
-                             lambda m=msg, f=frm, b=bus: b.process_incoming(m, f))
+        for off in offsets:
+            self._timer.schedule(
+                latency + off,
+                lambda m=msg, f=frm, b=bus: b.process_incoming(m, f))

@@ -228,8 +228,15 @@ def pack_vote(kind: int, sender: int, slot: int) -> int:
     """ONE vote -> its uint32 word (the wire layout's single definition).
 
     Bounds: sender < 8192, slot < 65536, kind < 4 — far above any real
-    pool. Packing at RECORD time keeps the hot flush path a single
-    ``np.fromiter`` over ints instead of a tuple-list conversion."""
+    pool, and ENFORCED: an out-of-range value would silently alias
+    another sender/slot bit-field (the old MsgBatch path kept fields in
+    separate int32 lanes; the packed word does not forgive). Packing at
+    RECORD time keeps the hot flush path a single ``np.fromiter`` over
+    ints instead of a tuple-list conversion."""
+    if not (0 <= kind < 4 and 0 <= sender < 8192 and 0 <= slot < 65536):
+        raise ValueError(
+            f"vote field out of packed range: kind={kind} (<4), "
+            f"sender={sender} (<8192), slot={slot} (<65536)")
     return 0x80000000 | (kind << 29) | (sender << 16) | slot
 
 

@@ -67,7 +67,9 @@ def b58decode(text: str | bytes) -> bytes:
                 v = v * 58 + _INDEX[ch]
             num = num * _POW58[len(chunk)] + v
     except KeyError as exc:
+        # exc.args[0] is the raw byte (iterating bytes yields ints);
+        # report the CHARACTER, same as the native codec
         raise ValueError(
-            f"invalid base58 character {exc.args[0]!r}") from None
+            f"invalid base58 character {chr(exc.args[0])!r}") from None
     body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
     return b"\0" * n_zeros + body

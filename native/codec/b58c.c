@@ -39,10 +39,21 @@ static PyObject *py_b58_decode(PyObject *self, PyObject *arg) {
     for (Py_ssize_t i = 0; i < n; i++) {
         int d = INDEX[(unsigned char)text[i]];
         if (d < 0) {
+            /* match the pure-Python fallback's message: the offending
+             * CHARACTER with repr quoting (e.g. '0'), not the raw byte
+             * value — PyObject_Repr gives Python's exact quoting rules
+             * for non-printables too */
+            PyObject *ch, *r;
             PyMem_Free(buf);
-            return PyErr_Format(PyExc_ValueError,
-                                "invalid base58 character %d",
-                                (int)(unsigned char)text[i]);
+            ch = PyUnicode_FromOrdinal((int)(unsigned char)text[i]);
+            if (!ch) return NULL;
+            r = PyObject_Repr(ch);
+            Py_DECREF(ch);
+            if (!r) return NULL;
+            PyErr_Format(PyExc_ValueError,
+                         "invalid base58 character %U", r);
+            Py_DECREF(r);
+            return NULL;
         }
         unsigned int carry = (unsigned int)d;
         for (Py_ssize_t j = 0; j < used; j++) {

@@ -1,0 +1,72 @@
+"""Run a chaos scenario against the simulated RBFT pool.
+
+Usage:
+    python scripts/chaos_run.py --seed 7 --scenario f_crash_partition
+    python scripts/chaos_run.py --list
+    python scripts/chaos_run.py --seed 3 --scenario storm --out storm.json
+
+Every run is fully determined by (scenario, seed, nodes): the emitted
+JSON report contains the fault plan, the virtual-time event trace,
+delivery accounting and all invariant verdicts, plus the exact command
+that replays it. Exit status: 0 when the verdicts match the scenario's
+design (all PASS for normal scenarios; the designed failures for
+checker-vacuity scenarios like broken_agreement), 2 otherwise.
+"""
+import argparse
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from indy_plenum_tpu.chaos import SCENARIOS, run_scenario  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="deterministic fault injection for the RBFT sim pool")
+    parser.add_argument("--seed", type=int, default=7,
+                        help="plan + pool seed (the replay key)")
+    parser.add_argument("--scenario", default="f_crash_partition",
+                        choices=sorted(SCENARIOS),
+                        help="named fault scenario")
+    parser.add_argument("--nodes", type=int, default=0,
+                        help="pool size (0 = scenario default)")
+    parser.add_argument("--out", default=None,
+                        help="report path (default: "
+                             "chaos_<scenario>_<seed>.json)")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and exit")
+    args = parser.parse_args()
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            tag = (" [expects FAIL: " + ", ".join(sc.expect_fail) + "]"
+                   if sc.expect_fail else "")
+            print(f"{name:24s} {sc.description}{tag}")
+        return 0
+
+    out = args.out or f"chaos_{args.scenario}_{args.seed}.json"
+    report = run_scenario(args.scenario, seed=args.seed,
+                          n_nodes=args.nodes, out_path=out)
+    for line in report.summary_lines():
+        print(line)
+    print(f"  report: {out}")
+    if report.verdict_as_expected:
+        if report.expected_failures:
+            print("OK (failed exactly as designed — checker not vacuous)")
+        else:
+            print("OK (all invariants PASS)")
+        return 0
+    print(f"UNEXPECTED VERDICT: failed={report.failed} "
+          f"expected={report.expected_failures}")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

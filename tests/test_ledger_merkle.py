@@ -136,3 +136,22 @@ def test_recover_tree_when_hash_store_ahead_of_log():
     assert replayed == 10  # full rebuild from the log
     assert led.size == 10 and led.root_hash == root10
     assert led.get_by_seq_no(10) is not None
+    # the hash store was reset BEFORE the rebuild: its durable leaf_count
+    # must match the rebuilt tree, and a fresh tree over the same store
+    # must load the recovered size, not the stale oversized one
+    assert led.tree.hash_store.leaf_count == 10
+    assert CompactMerkleTree(hash_store=led.tree.hash_store).tree_size == 10
+
+
+def test_recover_tree_ahead_of_empty_log_clears_stale_leaf_count():
+    """Tree ahead with an EMPTY log (the round-5 advisory case): without
+    resetting the hash store, the stale leaf_count key survives and every
+    restart reloads the oversized tree and re-runs the rebuild."""
+    store = KvHashStore(KeyValueStorageInMemory())
+    led = Ledger(tree=CompactMerkleTree(hash_store=store))
+    led.tree.append(b"phantom")
+    led.seq_no = 1
+    assert led.recover_tree() == 0
+    assert led.size == 0 and led.tree.tree_size == 0
+    assert store.leaf_count == 0  # durably cleared, not just in-memory
+    assert CompactMerkleTree(hash_store=store).tree_size == 0

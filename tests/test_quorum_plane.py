@@ -111,3 +111,14 @@ def test_word_pack_roundtrip_and_group_equivalence():
     _, ev_w = q.step(q.init_state(N, S, C), unpacked, N)
     for a, b in zip(ev_ref, ev_w):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pack_vote_enforces_field_bounds():
+    """An out-of-range kind/sender/slot would silently alias another
+    bit-field in the packed word; pack_vote must refuse instead."""
+    assert q.pack_vote(3, 8191, 65535) == 0xFFFFFFFF
+    assert q.pack_vote(0, 0, 0) == 0x80000000
+    for kind, sender, slot in ((4, 0, 0), (0, 8192, 0), (0, 0, 65536),
+                               (-1, 0, 0), (0, -1, 0), (0, 0, -1)):
+        with pytest.raises(ValueError):
+            q.pack_vote(kind, sender, slot)

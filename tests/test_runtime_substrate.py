@@ -135,6 +135,67 @@ def test_base58_roundtrip():
     assert b58encode(b"hello") == "Cn8eVZg"
 
 
+def test_base58_decode_error_message_same_on_both_backends():
+    """The native codec and the pure-Python oracle must report an invalid
+    digit identically: the offending CHARACTER (repr-quoted), not the raw
+    byte value."""
+    from indy_plenum_tpu.utils import base58
+
+    def message(text):
+        try:
+            base58.b58decode(text)
+        except ValueError as exc:
+            return str(exc)
+        raise AssertionError(f"accepted invalid {text!r}")
+
+    native = base58._C
+    for bad, want in (("ab0cd", "'0'"), ("xIy", "'I'"),
+                      (b"ab\x07cd", r"'\x07'")):
+        msgs = set()
+        for backend in (native, None):
+            if backend is None and native is None:
+                continue  # no compiler: the fallback was already covered
+            base58._C = backend
+            try:
+                msgs.add(message(bad))
+            finally:
+                base58._C = native
+        assert msgs == {f"invalid base58 character {want}"}, msgs
+
+
+def test_stash_replay_survives_reentrant_unstash():
+    """process_stashed must tolerate a handler that reenters
+    process_stashed for the SAME reason (a fetched PRE-PREPARE unstashing
+    its successors does exactly this) — the outer loop's snapshot bound
+    must not pop from the queue the inner call drained."""
+    from indy_plenum_tpu.common.stashing_router import (
+        PROCESS,
+        StashingRouter,
+    )
+
+    class Msg:
+        def __init__(self, n):
+            self.n = n
+
+    router = StashingRouter(limit=10)
+    order = []
+
+    def handler(m):
+        order.append(m.n)
+        # first replayed message drains the rest reentrantly
+        if m.n == 0:
+            router.process_stashed(7)
+        return PROCESS
+
+    router.subscribe(Msg, lambda m: 7)  # stash everything under reason 7
+    for i in range(4):
+        router.process(Msg(i))
+    router._handlers[Msg] = handler  # now replay for real
+    router.process_stashed(7)
+    assert order == [0, 1, 2, 3]
+    assert router.stash_size(7) == 0
+
+
 def test_queue_timer_zero_delay_reschedule_does_not_hang():
     # A 0-delay self-rescheduling callback under a frozen virtual clock must
     # fire once per service() pass, not loop forever.

@@ -41,14 +41,30 @@ BASE_CONFIG = {
 def run_scenario(scenario: "str | Scenario", seed: int,
                  n_nodes: int = 0,
                  out_path: Optional[str] = None,
-                 probe_interval: float = 1.0) -> ChaosReport:
+                 probe_interval: float = 1.0,
+                 device_quorum: bool = False,
+                 quorum_tick_interval: float = 0.0) -> ChaosReport:
+    """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
+    through the tick-batched dispatch plane (grouped device flushes, per-
+    tick quorum evaluation) — fault paths must survive the tick barrier
+    exactly as they do the per-message loop, and the report's metrics
+    then carry the dispatch amortization numbers."""
+    if quorum_tick_interval > 0 and not device_quorum:
+        # the services gate tick mode on having a vote plane: without
+        # device_quorum the override would silently run the plain
+        # per-message loop while the caller believes otherwise
+        raise ValueError("quorum_tick_interval requires device_quorum")
     if isinstance(scenario, str):
         scenario = get_scenario(scenario)
     n = n_nodes or scenario.n_nodes
     plan = scenario.plan(seed, n)
 
-    config = getConfig({**BASE_CONFIG, **scenario.config_overrides})
-    pool = SimPool(n_nodes=n, seed=seed, config=config)
+    overrides = {**BASE_CONFIG, **scenario.config_overrides}
+    if quorum_tick_interval > 0:
+        overrides["QuorumTickInterval"] = quorum_tick_interval
+    config = getConfig(overrides)
+    pool = SimPool(n_nodes=n, seed=seed, config=config,
+                   device_quorum=device_quorum)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,

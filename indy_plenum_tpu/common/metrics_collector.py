@@ -29,6 +29,13 @@ class MetricsName:
     DEVICE_FLUSH = "device.flush"
     DEVICE_FLUSH_TIME = "device.flush_time"
     DEVICE_FLUSH_VOTES = "device.flush_votes"
+    # dispatch plane (tick-batched mode): how many device steps one tick
+    # actually cost, and what fraction of each padded scatter carried
+    # real votes. Together they are the measured amortization story —
+    # device_dispatches_per_tick should sit near 1, flush_occupancy near
+    # the votes-per-tick / padded-shape ratio (see README "Performance").
+    DEVICE_DISPATCHES_PER_TICK = "device.dispatches_per_tick"
+    DEVICE_FLUSH_OCCUPANCY = "device.flush_occupancy"
     # execution
     COMMIT_TIME = "exec.commit_time"
     # catchup

@@ -21,7 +21,8 @@ class TimerService(ABC):
         ...
 
     @abstractmethod
-    def schedule(self, delay: float, callback: Callable[[], None]) -> None:
+    def schedule(self, delay: float, callback: Callable[[], None],
+                 barrier: bool = False) -> None:
         ...
 
     @abstractmethod
@@ -32,6 +33,10 @@ class TimerService(ABC):
 
 class _Event(NamedTuple):
     timestamp: float
+    # barrier events sort AFTER every plain event due at the same
+    # timestamp: the dispatch-plane tick must observe a fully drained
+    # delivery set, never race a same-instant message
+    priority: int
     counter: int  # tie-break so heap order is deterministic & insertion-stable
     callback: Callable[[], None]
 
@@ -51,11 +56,16 @@ class QueueTimer(TimerService):
     def queue_size(self) -> int:
         return len(self._events) - len(self._cancelled)
 
-    def schedule(self, delay: float, callback: Callable[[], None]) -> None:
+    def schedule(self, delay: float, callback: Callable[[], None],
+                 barrier: bool = False) -> None:
+        """``barrier=True`` defers the event behind every plain event due
+        at the same timestamp (the tick-batched dispatch plane's drain
+        contract: deliveries first, quorum evaluation after)."""
         self._counter += 1
         heappush(
             self._events,
-            _Event(self.get_current_time() + delay, self._counter, callback),
+            _Event(self.get_current_time() + delay, 1 if barrier else 0,
+                   self._counter, callback),
         )
 
     def cancel(self, callback: Callable[[], None]) -> None:
@@ -100,7 +110,8 @@ class RepeatingTimer:
     """
 
     def __init__(self, timer: TimerService, interval: float,
-                 callback: Callable[[], None], active: bool = True):
+                 callback: Callable[[], None], active: bool = True,
+                 barrier: bool = False):
         if interval <= 0:
             raise ValueError("interval must be positive")
         self._timer = timer
@@ -108,6 +119,7 @@ class RepeatingTimer:
         self._user_callback = callback
         self._active = False
         self._generation = 0
+        self._barrier = barrier
         self._pending: Callable[[], None] | None = None
         if active:
             self.start()
@@ -117,7 +129,8 @@ class RepeatingTimer:
         def occurrence():
             self._fire(generation)
         self._pending = occurrence
-        self._timer.schedule(self._interval, occurrence)
+        self._timer.schedule(self._interval, occurrence,
+                             barrier=self._barrier)
 
     def _fire(self, generation: int) -> None:
         if not self._active or generation != self._generation:

@@ -38,11 +38,16 @@ class Monitor:
                  timer: TimerService,
                  bus: InternalBus,
                  config,
-                 num_instances: int):
+                 num_instances: int,
+                 metrics=None):
         self._name = name
         self._timer = timer
         self._bus = bus
         self._config = config
+        # dispatch-plane observability: when the node's collector is
+        # handed in, snapshot() surfaces the device amortization numbers
+        # (dispatches per tick, flush occupancy) next to the RBFT ratios
+        self._metrics = metrics
         # digest -> finalisation timestamp (latency measurement base)
         self._finalised_at: Dict[str, float] = {}
         self._throughputs: List[WindowedThroughputMeasurement] = []
@@ -122,12 +127,29 @@ class Monitor:
         per-instance throughput, the master/backup ratio the Delta check
         judges, and how often this node voted the master degraded."""
         now = self._timer.get_current_time()
-        return {
+        snap = {
             "throughput_per_instance": [
                 t.get_throughput(now) for t in self._throughputs],
             "master_throughput_ratio": self.master_throughput_ratio(),
             "degradation_votes": self.degradation_votes,
         }
+        if self._metrics is not None:
+            from ..common.metrics_collector import MetricsName
+
+            device = {}
+            for label, name in (
+                    ("dispatches_per_tick",
+                     MetricsName.DEVICE_DISPATCHES_PER_TICK),
+                    ("flush_occupancy",
+                     MetricsName.DEVICE_FLUSH_OCCUPANCY),
+                    ("flushes", MetricsName.DEVICE_FLUSH)):
+                stat = self._metrics.stat(name)
+                if stat is not None:
+                    device[label] = {"count": stat.count,
+                                     "avg": round(stat.avg, 4)}
+            if device:
+                snap["device_dispatch"] = device
+        return snap
 
     def master_throughput_ratio(self) -> Optional[float]:
         if len(self._throughputs) < 2:

@@ -309,7 +309,8 @@ class Node:
         from .replicas import Replicas
 
         self.monitor = Monitor(name, timer, self.internal_bus, self.config,
-                               num_instances=num_instances)
+                               num_instances=num_instances,
+                               metrics=self.metrics)
         # backup pools are bounded drop-oldest: a stalled backup primary
         # must read as a SLOW instance, not as unbounded node memory
         self.replicas = Replicas(
@@ -356,9 +357,11 @@ class Node:
         if (drive_quorum_ticks and vote_plane is not None
                 and self.config.QuorumTickInterval > 0):
             vote_plane.defer_flush_on_query = True
+            # barrier: deliveries due at the tick instant drain first, so
+            # the tick evaluates a complete delivery set (dispatch plane)
             self._quorum_tick_timer = RepeatingTimer(
                 timer, self.config.QuorumTickInterval, self._quorum_tick,
-                active=False)
+                active=False, barrier=True)
         self.vote_plane = vote_plane
 
         # --- notifier: operator events -> pluggable sinks ----------------
@@ -399,7 +402,14 @@ class Node:
             self._quorum_tick_timer.stop()
 
     def _quorum_tick(self) -> None:
+        # dispatch-plane order: drain the signed-request ingress (one
+        # device auth batch), scatter buffered votes (one grouped device
+        # step), then evaluate quorums against the fresh snapshot
+        self._flush_auth_queue()
+        before = self.vote_plane.flushes
         self.vote_plane.sync()
+        self.metrics.add_event(MetricsName.DEVICE_DISPATCHES_PER_TICK,
+                               self.vote_plane.flushes - before)
         self.ordering.service_quorum_tick()
         self.checkpoints.service_quorum_tick()
         for backup in self.replicas.backups:

@@ -89,7 +89,7 @@ class NodePool:
         if device_quorum:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
-                num_instances=resolved_instances)
+                num_instances=resolved_instances, metrics=self.metrics)
 
         tick_mode = self.config.QuorumTickInterval > 0
 
@@ -117,14 +117,27 @@ class NodePool:
                 seed_keys=dict(seed_keys), bls_keys=self.bls_keys,
                 vote_plane=plane, num_instances=num_instances,
                 drive_quorum_ticks=False,  # the pool drives group ticks
+                # shared collector: the dispatch-plane numbers the pool
+                # tick records are then visible in every node's
+                # Monitor.snapshot() (and node metrics aggregate pool-wide)
+                metrics=self.metrics,
                 backup_vote_plane_factory=backup_plane_factory(i))
             self.nodes.append(node)
         self.network.connect_all()
         for node in self.nodes:
             node.start()
 
+        def drain_auth_queues() -> None:
+            # ingress rides the dispatch tick: each node's queued signed
+            # requests get one device auth batch before votes scatter
+            # (the per-node PropagateBatchWait timer still covers the
+            # per-message mode and sub-interval bursts)
+            for nd in self.nodes:
+                nd._flush_auth_queue()
+
         self._quorum_tick_timer = drive_group_ticks(
-            self.timer, self.config, self.vote_group, self.nodes)
+            self.timer, self.config, self.vote_group, self.nodes,
+            ingress=drain_auth_queues)
 
         self._req_seq = 0
 

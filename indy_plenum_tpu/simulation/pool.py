@@ -403,10 +403,13 @@ class SimPool:
 
         self.vote_group = None
         if device_quorum:
+            # the group shares the pool's collector so the dispatch-plane
+            # numbers (device.flush / dispatches_per_tick / occupancy)
+            # land where bench and chaos reports already look
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
                 num_instances=num_instances, mesh=mesh,
-                pipelined=pipelined_flush)
+                pipelined=pipelined_flush, metrics=self.metrics)
 
         k = num_instances
         self.nodes: List[SimNode] = [
@@ -489,10 +492,14 @@ class SimPool:
 
         # tick-batched quorum mode: ONE group flush per tick serves the
         # whole pool; services evaluate against that snapshot and votes
-        # recorded during the wave buffer for the next tick
+        # recorded during the wave buffer for the next tick. Signed
+        # ingress rides the same tick: requests submitted during the
+        # interval get ONE device batch verify at tick start.
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,
-            accounting=self.host_seconds)
+            accounting=self.host_seconds,
+            ingress=(self.flush_ingress if self.authnr is not None
+                     else None))
 
     def _install_accounting(self, node: "SimNode") -> None:
         import time as _time
@@ -571,11 +578,17 @@ class SimPool:
     def flush_ingress(self):
         """The node-ingress pipeline stand-in: device-batch-verify pending
         signed requests; only verified ones become finalised. Returns the
-        verdict vector (test observability)."""
+        verdict vector (test observability). In tick-batched mode the
+        dispatch-plane tick calls this automatically, so every request
+        submitted during the interval rides ONE Ed25519 device dispatch."""
         if not self._ingress:
             return []
         batch, self._ingress = self._ingress, []
-        verdicts = self.authnr.authenticate_batch(batch)
+        from ..common.metrics_collector import MetricsName
+
+        self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
+        with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
+            verdicts = self.authnr.authenticate_batch(batch)
         for req, ok in zip(batch, verdicts):
             if ok:
                 self.requests.add_finalised(req)

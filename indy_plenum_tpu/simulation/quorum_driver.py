@@ -5,18 +5,29 @@ wired directly) and :class:`~indy_plenum_tpu.simulation.node_pool.NodePool`
 (full Node composition roots) share one grouped device vote plane and, in
 tick-batched mode, one pool-level tick that flushes the whole group once
 and then lets every node evaluate against the fresh snapshot.
+
+The tick is the dispatch-plane barrier (README "Performance"): it is
+scheduled with ``barrier=True`` so every network delivery due at the tick
+instant lands FIRST; the tick then (1) drains the signed-request ingress
+through one device batch verify, (2) scatters the whole pool's buffered
+votes in one grouped device step, and (3) lets every service evaluate
+against the fresh snapshot. ``device.dispatches_per_tick`` and
+``device.flush_occupancy`` land in the group's metrics collector so the
+amortization is a regression-guarded number
+(``scripts/check_dispatch_budget.py``).
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
+from ..common.metrics_collector import MetricsName
 from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config
 
 
 def make_vote_group(n_nodes: int, validators, config: Config,
                     num_instances: int = 1, mesh=None,
-                    pipelined: bool = False):
+                    pipelined: bool = False, metrics=None):
     """Member axis = (node x instance): member i*num_instances + inst_id
     is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
     mapping — instances are a leading tensor dimension, so backups' vote
@@ -30,17 +41,25 @@ def make_vote_group(n_nodes: int, validators, config: Config,
         n_nodes * max(1, num_instances), list(validators),
         log_size=config.LOG_SIZE,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
-        mesh=mesh, pipelined=pipelined)
+        mesh=mesh, pipelined=pipelined, metrics=metrics)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
-                      nodes, accounting=None) -> Optional[RepeatingTimer]:
+                      nodes, accounting=None,
+                      ingress: Optional[Callable[[], None]] = None
+                      ) -> Optional[RepeatingTimer]:
     """Start the pool-level quorum tick (tick-batched mode only).
 
     Each node must expose ``vote_plane`` / ``ordering`` / ``checkpoints``;
     queries between ticks read the per-tick snapshot
     (``defer_flush_on_query``), and ONE group flush per tick serves the
-    whole pool. ``accounting`` (name -> seconds) attributes each node's
+    whole pool. The tick is a ``barrier`` timer event: deliveries due at
+    the tick instant drain before it fires, so quorum evaluation never
+    races a same-instant message. ``ingress`` (optional) drains the
+    pool's signed-request queue through one device batch verify at tick
+    start — requests that arrived during the interval ride one Ed25519
+    dispatch, then their finalisation is visible to the same tick's batch
+    timers. ``accounting`` (name -> seconds) attributes each node's
     tick evaluation to it, plus the FULL shared flush time to EVERY node
     (conservative: a deployed node flushes only its own plane).
     """
@@ -51,9 +70,20 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
 
     from time import perf_counter
 
+    last_flushes = [vote_group.flushes]
+
     def tick() -> None:
+        # ingress stays OUTSIDE the accounted window: SimPool's shared
+        # ingress is a pool-level stand-in — charging its auth batch to
+        # every node's host_seconds would n-fold over-count it
+        if ingress is not None:
+            ingress()
         t0 = perf_counter() if accounting is not None else 0.0
         vote_group.flush()
+        vote_group.metrics.add_event(
+            MetricsName.DEVICE_DISPATCHES_PER_TICK,
+            vote_group.flushes - last_flushes[0])
+        last_flushes[0] = vote_group.flushes
         flush_dt = perf_counter() - t0 if accounting is not None else 0.0
         for node in nodes:
             t0 = perf_counter() if accounting is not None else 0.0
@@ -67,4 +97,5 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
             if accounting is not None:
                 accounting[node.name] += (perf_counter() - t0) + flush_dt
 
-    return RepeatingTimer(timer, config.QuorumTickInterval, tick)
+    return RepeatingTimer(timer, config.QuorumTickInterval, tick,
+                          barrier=True)

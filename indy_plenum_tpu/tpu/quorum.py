@@ -50,6 +50,21 @@ COMMIT = 2
 CHECKPOINT = 3
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the top-level alias (with
+    ``check_vma``) only exists on newer releases; older ones ship it as
+    ``jax.experimental.shard_map`` (with ``check_rep``). Same semantics —
+    replication checking off, because QuorumEvents mixes replicated and
+    psum-derived outputs the checker cannot type."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 class VoteState(NamedTuple):
     """Device-resident per-instance vote tensors (slots are h-relative)."""
 
@@ -196,12 +211,11 @@ def make_sharded_step(mesh: Mesh, n_validators: int, axis: str = "validators"):
         commit_counts=P(),
     )
 
-    shard_fn = jax.shard_map(
+    shard_fn = shard_map_compat(
         inner,
         mesh=mesh,
         in_specs=(row_sharded, replicated_msgs),
         out_specs=(row_sharded, events_spec),
-        check_vma=False,
     )
     return jax.jit(shard_fn)
 
@@ -240,12 +254,27 @@ def pack_vote(kind: int, sender: int, slot: int) -> int:
     return 0x80000000 | (kind << 29) | (sender << 16) | slot
 
 
-def words_row(packed_words, max_batch: int) -> np.ndarray:
-    """(already-packed uint32 vote ints) -> zero-padded (max_batch,) row.
-    The ONE definition of the padded row layout every flush path uses."""
-    out = np.zeros(max_batch, np.uint32)
-    out[: len(packed_words)] = np.fromiter(packed_words, np.uint32,
+# The codec fast path for the dispatch plane: the same (kind, sender,
+# slot) triple recurs constantly — every node (x f+1 instances) records
+# node_j's PREPARE for slot s — so the packed word is memoized pool-wide.
+# A hit skips the bounds re-validation in pack_vote; entries are 28-bit
+# keys, so even a pathological run stays bounded by the cache size.
+vote_word = functools.lru_cache(maxsize=1 << 18)(pack_vote)
+
+
+def fill_words_row(row: np.ndarray, packed_words) -> None:
+    """Write pre-packed uint32 vote ints into a zeroed row buffer — the
+    ONE definition of the padded row layout every flush path uses (the
+    single-plane path via :func:`words_row`, the group path writing
+    straight into its (M, B) scatter buffer)."""
+    row[: len(packed_words)] = np.fromiter(packed_words, np.uint32,
                                            len(packed_words))
+
+
+def words_row(packed_words, max_batch: int) -> np.ndarray:
+    """(already-packed uint32 vote ints) -> zero-padded (max_batch,) row."""
+    out = np.zeros(max_batch, np.uint32)
+    fill_words_row(out, packed_words)
     return out
 
 

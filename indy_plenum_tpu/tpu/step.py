@@ -83,7 +83,7 @@ def make_sharded_fused_step(
         prepare_counts=P(),
         commit_counts=P(),
     )
-    shard_fn = jax.shard_map(
+    shard_fn = q.shard_map_compat(
         inner,
         mesh=mesh,
         in_specs=(
@@ -95,6 +95,5 @@ def make_sharded_fused_step(
             batch_sharded,
         ),
         out_specs=(row_sharded, events_spec, P()),
-        check_vma=False,
     )
     return jax.jit(shard_fn)

@@ -19,10 +19,18 @@ caller records its OWN votes too, not just received messages.
 
 Flush granularity: a quorum query flushes whatever is pending, so in the
 per-message sim loop each message typically costs one padded device step —
-correct but not amortized. Amortization comes from the callers that batch:
-the ingress path verifies whole request batches, and the dense-pool bench
-packs entire protocol rounds per step. A future Node event loop should
-drain deliveries before querying (one flush per tick).
+correct but not amortized. Amortization comes from the tick-batched
+dispatch plane (``simulation/quorum_driver.py`` / ``Node._quorum_tick``):
+the event loop drains all deliveries due at the tick, then ONE grouped
+device step carries every buffered vote from all members and f+1
+instances (drain -> scatter -> single grouped step -> read events). The
+ingress path likewise verifies whole request batches per tick.
+
+Padded flush shapes come from a small ladder (``FLUSH_LADDER``): each
+rung compiles exactly once, and a near-empty tick rides the smallest rung
+instead of paying the full-width scatter for a handful of votes.
+``flush_occupancy`` (votes / padded capacity) is recorded per dispatch so
+the amortization is a measured number, not a docstring claim.
 """
 from __future__ import annotations
 
@@ -39,6 +47,19 @@ from . import quorum as q
 
 # fixed flush granularity: stable shapes keep XLA from recompiling
 FLUSH_BATCH = 128
+# padded-shape ladder: a flush pads to the smallest rung that fits, so a
+# single-vote tick costs a 16-wide scatter, not a 128-wide one. Every
+# rung is a distinct static shape — each compiles once, then caches.
+FLUSH_LADDER = (16, FLUSH_BATCH)
+
+
+def ladder_shape(n_votes: int) -> int:
+    """Smallest ladder rung holding ``n_votes`` (callers chunk at
+    FLUSH_BATCH, so the top rung always fits)."""
+    for rung in FLUSH_LADDER:
+        if n_votes <= rung:
+            return rung
+    return FLUSH_BATCH
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -157,7 +178,7 @@ class DeviceVotePlane:
         idx = 0 if sender is None else self._index.get(sender)
         if idx is None:
             return
-        self._pending.append(q.pack_vote(kind, idx, slot))
+        self._pending.append(q.vote_word(kind, idx, slot))
         self._events = None
 
     def record_preprepare(self, pp_seq_no: int) -> None:
@@ -172,7 +193,7 @@ class DeviceVotePlane:
     def record_checkpoint(self, sender: str, chk_slot: int) -> None:
         if 0 <= chk_slot < self._n_chk and sender in self._index:
             self._pending.append(
-                q.pack_vote(q.CHECKPOINT, self._index[sender], chk_slot))
+                q.vote_word(q.CHECKPOINT, self._index[sender], chk_slot))
             self._events = None
 
     def checkpoint_slot(self, seq_no_end: int, chk_freq: int) -> Optional[int]:
@@ -229,7 +250,7 @@ class DeviceVotePlane:
         while self._pending:
             chunk, self._pending = (self._pending[:FLUSH_BATCH],
                                     self._pending[FLUSH_BATCH:])
-            words = jnp.asarray(q.words_row(chunk, FLUSH_BATCH))
+            words = jnp.asarray(q.words_row(chunk, ladder_shape(len(chunk))))
             self._state, self._events = _step_words(
                 self._state, words, self._n)
             self.flushes += 1
@@ -238,7 +259,7 @@ class DeviceVotePlane:
         self._flush()
         if self._events is None:  # nothing ever recorded
             self._state, self._events = _step_words(
-                self._state, jnp.asarray(q.words_row([], FLUSH_BATCH)),
+                self._state, jnp.asarray(q.words_row([], FLUSH_LADDER[0])),
                 self._n)
         (self._host_prepared, self._host_prepare_counts,
          self._host_commit_counts, self._host_stable) = jax.device_get(
@@ -288,10 +309,14 @@ def _pack_group_words(chunks: List[List[int]], max_batch: int
     One vectorized row write per member (a dense-pool tick flushes tens
     of thousands of votes) and one word per vote on the wire — the
     host->device transfer is the blocking cost of a flush."""
-    # entries are pre-packed words (q.pack_vote at record time); one
-    # vectorized q.words_row per member, no tuple-list conversion
-    return jnp.asarray(np.stack(
-        [q.words_row(entries, max_batch) for entries in chunks]))
+    # entries are pre-packed words (q.vote_word at record time): the rows
+    # land straight in the final (M, B) buffer — no per-member row array,
+    # no stack copy, no MsgBatch struct re-materialized anywhere host-side
+    out = np.zeros((len(chunks), max_batch), np.uint32)
+    for i, entries in enumerate(chunks):
+        if entries:
+            q.fill_words_row(out[i], entries)
+    return jnp.asarray(out)
 
 
 class VotePlaneGroup:
@@ -397,18 +422,25 @@ class VotePlaneGroup:
                                     m._pending[FLUSH_BATCH:])
                 chunks.append(take)
                 votes += len(take)
-            words = self._place(_pack_group_words(chunks, FLUSH_BATCH))
+            # the padded width rides the busiest member: a quiet tick
+            # (a few straggler votes) scatters 16-wide, a full protocol
+            # wave 128-wide — each rung is one cached XLA compilation
+            shape = ladder_shape(max(len(c) for c in chunks))
+            words = self._place(_pack_group_words(chunks, shape))
             self._states, events = _group_step_words(
                 self._states, words, self._n)
             self.flushes += 1
             self.metrics.add_event(MetricsName.DEVICE_FLUSH)
             self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
+            self.metrics.add_event(
+                MetricsName.DEVICE_FLUSH_OCCUPANCY,
+                votes / (len(self._members) * shape))
         return events
 
     def _dispatch_empty(self):
         """One padded no-vote step (cold start needs SOME events)."""
         words = self._place(_pack_group_words(
-            [[] for _ in self._members], FLUSH_BATCH))
+            [[] for _ in self._members], FLUSH_LADDER[0]))
         self._states, events = _group_step_words(
             self._states, words, self._n)
         self.flushes += 1

@@ -41,7 +41,15 @@ def main() -> int:
                              "chaos_<scenario>_<seed>.json)")
     parser.add_argument("--list", action="store_true",
                         help="list scenarios and exit")
+    parser.add_argument("--device-quorum", action="store_true",
+                        help="decide quorums on the device vote plane")
+    parser.add_argument("--tick", type=float, default=0.0,
+                        help="QuorumTickInterval: > 0 routes the scenario "
+                             "through the tick-batched dispatch plane "
+                             "(requires --device-quorum)")
     args = parser.parse_args()
+    if args.tick > 0 and not args.device_quorum:
+        parser.error("--tick requires --device-quorum")
 
     if args.list:
         for name in sorted(SCENARIOS):
@@ -53,7 +61,9 @@ def main() -> int:
 
     out = args.out or f"chaos_{args.scenario}_{args.seed}.json"
     report = run_scenario(args.scenario, seed=args.seed,
-                          n_nodes=args.nodes, out_path=out)
+                          n_nodes=args.nodes, out_path=out,
+                          device_quorum=args.device_quorum,
+                          quorum_tick_interval=args.tick)
     for line in report.summary_lines():
         print(line)
     print(f"  report: {out}")

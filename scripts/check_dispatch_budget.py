@@ -1,0 +1,118 @@
+"""Dispatch-budget gate: fail if the tick barrier stops amortizing.
+
+Runs a short deterministic ``SimPool`` round through the tick-batched
+dispatch plane and computes ``device_dispatches_per_ordered_batch`` (and
+dispatches per delivered message). Exit status 1 if either exceeds its
+budget — callable from the bench loop, chaos runs, or CI, so a regression
+that quietly reverts to per-message flushing turns red instead of slow.
+
+Usage:
+    python scripts/check_dispatch_budget.py                # defaults
+    python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
+        --budget-per-batch 40 --json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from indy_plenum_tpu.common.metrics_collector import MetricsName  # noqa: E402
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+
+
+def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
+            tick_interval: float, seed: int = 11) -> dict:
+    """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
+    measurement: the gate counts every dispatch from pool construction on
+    (cold-start/compile steps included), because the budget protects the
+    whole loop's dispatch discipline, not the steady-state ratio. Budgets
+    are calibrated with ~10x headroom over the cold numbers."""
+    config = getConfig({
+        "Max3PCBatchSize": batch_size,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": tick_interval,
+    })
+    pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   num_instances=instances)
+
+    def min_ordered():
+        return min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    target = batches * batch_size
+    for i in range(target):
+        pool.submit_request(i)
+    deadline = time.monotonic() + 240
+    while min_ordered() < target and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert min_ordered() >= target, f"stalled at {min_ordered()}/{target}"
+    assert pool.honest_nodes_agree()
+
+    dispatches = pool.vote_group.flushes
+    delivered = pool.network.sent
+    occ = pool.metrics.stat(MetricsName.DEVICE_FLUSH_OCCUPANCY)
+    per_tick = pool.metrics.stat(MetricsName.DEVICE_DISPATCHES_PER_TICK)
+    return {
+        "n_nodes": n_nodes,
+        "instances": instances,
+        "txns_ordered": min_ordered(),
+        "ordered_batches": batches,
+        "device_dispatches": dispatches,
+        "delivered_messages": delivered,
+        "device_dispatches_per_ordered_batch": round(
+            dispatches / batches, 2),
+        "device_dispatches_per_delivered_message": round(
+            dispatches / delivered, 4) if delivered else 0.0,
+        "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
+        "dispatches_per_tick_max": per_tick.max if per_tick else None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--instances", type=int, default=1)
+    ap.add_argument("--batches", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=10)
+    ap.add_argument("--tick", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--budget-per-batch", type=float, default=25.0,
+                    help="max device dispatches per ordered batch")
+    ap.add_argument("--budget-per-message", type=float, default=0.25,
+                    help="max device dispatches per delivered message")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the measurement as one JSON line")
+    args = ap.parse_args()
+
+    result = measure(args.nodes, args.instances, args.batches,
+                     args.batch_size, args.tick, seed=args.seed)
+    per_batch = result["device_dispatches_per_ordered_batch"]
+    per_msg = result["device_dispatches_per_delivered_message"]
+    result["budget_per_batch"] = args.budget_per_batch
+    result["budget_per_message"] = args.budget_per_message
+    over = []
+    if per_batch > args.budget_per_batch:
+        over.append(f"dispatches/batch {per_batch} > {args.budget_per_batch}")
+    if per_msg > args.budget_per_message:
+        over.append(f"dispatches/message {per_msg} "
+                    f"> {args.budget_per_message}")
+    result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
+    if args.json:
+        print(json.dumps(result, separators=(",", ":")))
+    else:
+        for key, value in result.items():
+            print(f"{key}: {value}")
+    return 1 if over else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

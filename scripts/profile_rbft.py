@@ -1,8 +1,22 @@
 """Profile the full-RBFT sim loop on CPU: where do 22 instances spend it?
 
 Usage: python scripts/profile_rbft.py [n_nodes] [instances] [txns]
+                                      [--json] [--no-baseline]
+
+``--json`` emits ONE machine-readable line on stdout (everything else
+goes to stderr): the top-20 cumulative hotspots plus the dispatch-plane
+amortization numbers — ``device_dispatches_per_ordered_batch`` for the
+tick-batched run and, unless ``--no-baseline``, the same measured on a
+short per-message run (``QuorumTickInterval=0``) with the resulting
+``amortization_factor``. The determinism cross-check
+(``ordered_digests`` identical between the two modes) lives in
+``tests/test_dispatch_plane.py``; the budget gate in
+``scripts/check_dispatch_budget.py``.
 """
+import argparse
 import cProfile
+import json
+import os
 import pstats
 import sys
 import time
@@ -11,59 +25,137 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, "/root/repo")
+# repo root from this file's location, not a hardcoded absolute path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from indy_plenum_tpu.common.metrics_collector import MetricsName  # noqa: E402
 from indy_plenum_tpu.config import getConfig  # noqa: E402
 from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
 
+BATCH = 160
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
-    k = int(sys.argv[2]) if len(sys.argv) > 2 else 6
-    txns = int(sys.argv[3]) if len(sys.argv) > 3 else 320
-    batch = 160
+
+def _build_pool(n, k, tick_interval):
     config = getConfig({
-        "Max3PCBatchSize": batch,
+        "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
-        "QuorumTickInterval": 0.1,
+        "QuorumTickInterval": tick_interval,
     })
-    pool = SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
+    return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
                    shadow_check=False, num_instances=k)
-    seq = 0
+
+
+def _run(pool, txns, profile=False):
+    """Warm up one batch, then order ``txns`` more; returns the measured
+    segment's (ordered, wall_s, device_dispatches, profiler|None)."""
+    seq = [0]
 
     def submit(count):
-        nonlocal seq
         for _ in range(count):
-            seq += 1
-            pool.submit_request(seq)
+            seq[0] += 1
+            pool.submit_request(seq[0])
 
     def min_ordered():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
 
-    # warm-up
+    # warm-up: compiles the vote-plane step shapes + fills jit caches
     deadline = time.monotonic() + 240
-    submit(batch)
-    while min_ordered() < batch and time.monotonic() < deadline:
+    submit(BATCH)
+    while min_ordered() < BATCH and time.monotonic() < deadline:
         pool.run_for(0.5)
-    assert min_ordered() >= batch, "warm-up stalled"
+    assert min_ordered() >= BATCH, "warm-up stalled"
 
     submit(txns)
-    target = batch + txns
+    target = BATCH + txns
+    flushes0 = pool.vote_group.flushes
     deadline = time.monotonic() + 240  # fresh budget: warm-up (XLA
     # compile + flaky link) must not silently truncate the profiled run
-    prof = cProfile.Profile()
+    prof = cProfile.Profile() if profile else None
     t0 = time.perf_counter()
-    prof.enable()
+    if prof:
+        prof.enable()
     while min_ordered() < target and time.monotonic() < deadline:
         pool.run_for(0.5)
-    prof.disable()
+    if prof:
+        prof.disable()
     elapsed = time.perf_counter() - t0
-    got = min_ordered() - batch
+    got = min_ordered() - BATCH
+    dispatches = pool.vote_group.flushes - flushes0
+    return got, elapsed, dispatches, prof
+
+
+def _hotspots(prof, top=20):
+    """Top ``top`` functions by cumulative time, machine-readable."""
+    stats = pstats.Stats(prof)
+    rows = []
+    for (path, line, func), (cc, nc, tt, ct, _callers) in \
+            sorted(stats.stats.items(), key=lambda kv: -kv[1][3])[:top]:
+        rows.append({
+            "func": f"{os.path.basename(path)}:{line}({func})",
+            "ncalls": nc,
+            "tottime_s": round(tt, 4),
+            "cumtime_s": round(ct, 4),
+        })
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("n_nodes", nargs="?", type=int, default=16)
+    ap.add_argument("instances", nargs="?", type=int, default=6)
+    ap.add_argument("txns", nargs="?", type=int, default=320)
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable stdout line: top-20 "
+                         "hotspots + dispatch amortization metrics")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the per-message baseline run in --json mode")
+    args = ap.parse_args()
+    n, k, txns = args.n_nodes, args.instances, args.txns
+
+    pool = _build_pool(n, k, tick_interval=0.1)
+    got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
     stats = pstats.Stats(prof, stream=sys.stderr)
     stats.sort_stats("cumulative").print_stats(35)
     stats.sort_stats("tottime").print_stats(35)
+
+    if not args.json:
+        return
+
+    # fractional batches: a truncated or non-multiple-of-BATCH run must
+    # not skew dispatches-per-batch by up to 2x through floor division
+    batches = max(got / BATCH, 1e-9)
+    per_batch = dispatches / batches
+    occ = pool.metrics.stat(MetricsName.DEVICE_FLUSH_OCCUPANCY)
+    record = {
+        "n_nodes": n,
+        "instances": k,
+        "txns_ordered": got,
+        "wall_s": round(elapsed, 2),
+        "txns_per_sec": round(got / elapsed, 1) if elapsed else 0.0,
+        "device_dispatches": dispatches,
+        "ordered_batches": round(batches, 2),
+        "device_dispatches_per_ordered_batch": round(per_batch, 2),
+        "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
+        "hotspots_top20_cumulative": _hotspots(prof),
+    }
+    if not args.no_baseline:
+        # per-message baseline: same pool shape, QuorumTickInterval=0 —
+        # every quorum query flushes. One post-warm-up batch is enough;
+        # dispatches-per-ordered-batch is ~workload-independent.
+        base_pool = _build_pool(n, k, tick_interval=0.0)
+        bgot, belapsed, bdispatches, _ = _run(base_pool, BATCH)
+        base_per_batch = bdispatches / max(bgot / BATCH, 1e-9)
+        record.update({
+            "baseline_mode": "per_message",
+            "baseline_txns_ordered": bgot,
+            "baseline_device_dispatches_per_ordered_batch":
+                round(base_per_batch, 2),
+            "amortization_factor":
+                round(base_per_batch / per_batch, 2) if per_batch else None,
+        })
+    print(json.dumps(record, separators=(",", ":")))
 
 
 if __name__ == "__main__":

@@ -27,11 +27,18 @@ def test_entry_jits():
     assert np.asarray(ok).all()
 
 
+# the two dryrun contract tests compile the full fused sharded step from
+# scratch (the subprocess one twice, in a fresh interpreter): ~2 min
+# combined — far past the tier-1 per-test budget, so they ride the slow
+# lane (they only became runnable when shard_map_compat fixed the
+# jax-version break that had them erroring out instantly)
+@pytest.mark.slow
 def test_dryrun_multichip_in_process(eight_devices):
     # 8 virtual CPU devices exist (conftest) -> takes the in-process path.
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_self_provisions_subprocess():
     # More devices than this process has: must re-exec with a bigger
     # virtual host platform rather than assert.

@@ -151,11 +151,15 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
 
     batch_size = 320
     # the tick is SIM time (free): longer ticks mean fewer device
-    # round-trips per ordered batch with zero wall-clock latency cost
+    # round-trips per ordered batch with zero wall-clock latency cost.
+    # Adaptive (PR 3): the governor retunes the interval from the flush
+    # occupancy it observes — the trajectory is recorded in the extras
+    # digest so BENCH_r*.json tracks adaptation across rounds.
     config = getConfig({
         "Max3PCBatchSize": batch_size,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": 0.1,
+        "QuorumTickAdaptive": True,
     })
     pool = SimPool(n_nodes=n_nodes, seed=11, config=config,
                    device_quorum=True, shadow_check=False,
@@ -229,6 +233,10 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "device_dispatches_per_ordered_batch": round(
             measured_dispatches / max(ordered / batch_size, 1e-9), 2),
     }
+    if pool.governor is not None:
+        # the adaptation record: tick-interval min/median/max + the
+        # occupancy EWMA the control law settled on
+        out["governor"] = pool.governor.trajectory_summary()
     if host_accounting:
         busiest = max(pool.host_seconds.values())
         per_host_tps = ordered / busiest if busiest > 0 else 0.0
@@ -857,13 +865,22 @@ def main() -> None:
     compact = {k: line.get(k) for k in ("metric", "value", "unit",
                                         "vs_baseline")}
     if extras:
-        # [value, vs_baseline] (+ flush_occupancy for the tick-batched
-        # ordered sub-benches — index-based consumers keep [0]/[1])
-        compact["extras"] = {
-            e["metric"]: [e["value"], e["vs_baseline"]]
-            + ([e["flush_occupancy"]]
-               if e.get("flush_occupancy") is not None else [])
-            for e in extras}
+        # [value, vs_baseline] (+ flush_occupancy, + the governor's
+        # [tick_min, tick_median, tick_max, occupancy_ewma] for the
+        # tick-batched ordered sub-benches — index-based consumers keep
+        # [0]/[1])
+        def _extras_digest(e):
+            row = [e["value"], e["vs_baseline"]]
+            if e.get("flush_occupancy") is not None:
+                row.append(e["flush_occupancy"])
+            gov = e.get("governor")
+            if gov:
+                row.append([gov["interval_min"], gov["interval_median"],
+                            gov["interval_max"], gov["occupancy_ewma"]])
+            return row
+
+        compact["extras"] = {e["metric"]: _extras_digest(e)
+                             for e in extras}
     if errors:
         compact["errors"] = sorted(errors)
     compact["full"] = "BENCH_FULL.json"

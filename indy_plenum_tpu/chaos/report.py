@@ -25,6 +25,11 @@ class ChaosReport:
     network: Dict[str, Any] = field(default_factory=dict)
     metrics: Dict[str, Any] = field(default_factory=dict)
     ordered_per_node: Dict[str, int] = field(default_factory=dict)
+    # sha256 of each node's ordered-digest sequence: lets two runs (e.g.
+    # per-message vs tick-batched vs adaptive-tick on the same seed) be
+    # compared for ORDERING identity, not just count identity, without
+    # embedding every digest in the report
+    ordered_hash_per_node: Dict[str, str] = field(default_factory=dict)
     # RBFT monitor views, for pools whose nodes carry one (NodePool)
     monitor_per_node: Dict[str, Any] = field(default_factory=dict)
     byzantine_nodes: List[str] = field(default_factory=list)
@@ -63,6 +68,7 @@ class ChaosReport:
             "network": self.network,
             "metrics": self.metrics,
             "ordered_per_node": self.ordered_per_node,
+            "ordered_hash_per_node": self.ordered_hash_per_node,
             "monitor_per_node": self.monitor_per_node,
             "periodic_checks": self.periodic_checks,
             "first_violation": (list(self.first_violation)

@@ -10,6 +10,7 @@ at the end) and emit a replayable :class:`ChaosReport`.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Optional
 
 from ..config import getConfig
@@ -43,17 +44,24 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                  out_path: Optional[str] = None,
                  probe_interval: float = 1.0,
                  device_quorum: bool = False,
-                 quorum_tick_interval: float = 0.0) -> ChaosReport:
+                 quorum_tick_interval: float = 0.0,
+                 quorum_tick_adaptive: bool = False) -> ChaosReport:
     """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
     through the tick-batched dispatch plane (grouped device flushes, per-
     tick quorum evaluation) — fault paths must survive the tick barrier
     exactly as they do the per-message loop, and the report's metrics
-    then carry the dispatch amortization numbers."""
+    then carry the dispatch amortization numbers.
+    ``quorum_tick_adaptive`` additionally hands the tick to the dispatch
+    governor: the report's ``governor.tick_interval`` metrics then record
+    the interval trajectory (deterministic — replaying the same seed
+    yields the identical trajectory, which tests assert)."""
     if quorum_tick_interval > 0 and not device_quorum:
         # the services gate tick mode on having a vote plane: without
         # device_quorum the override would silently run the plain
         # per-message loop while the caller believes otherwise
         raise ValueError("quorum_tick_interval requires device_quorum")
+    if quorum_tick_adaptive and quorum_tick_interval <= 0:
+        raise ValueError("quorum_tick_adaptive requires a tick interval")
     if isinstance(scenario, str):
         scenario = get_scenario(scenario)
     n = n_nodes or scenario.n_nodes
@@ -62,6 +70,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     overrides = {**BASE_CONFIG, **scenario.config_overrides}
     if quorum_tick_interval > 0:
         overrides["QuorumTickInterval"] = quorum_tick_interval
+        overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
     config = getConfig(overrides)
     pool = SimPool(n_nodes=n, seed=seed, config=config,
                    device_quorum=device_quorum)
@@ -104,6 +113,10 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         metrics=pool.metrics.summary(),
         ordered_per_node={nd.name: len(nd.ordered_digests)
                           for nd in pool.nodes},
+        ordered_hash_per_node={
+            nd.name: hashlib.sha256(
+                "|".join(nd.ordered_digests).encode()).hexdigest()
+            for nd in pool.nodes},
         monitor_per_node={
             nd.name: nd.monitor.snapshot() for nd in pool.nodes
             if getattr(nd, "monitor", None) is not None},

@@ -3,9 +3,18 @@
 Reference: stp_core/loop/looper.py (`Looper`, `Prodable`) and motor.py
 (`Motor`). The reference wraps asyncio; here the loop is an explicit
 synchronous pump — deterministic, exception-isolating, and trivially
-embeddable in tests — that services the shared QueueTimer and *prods*
-every registered prodable (ZStacks, nodes) each pass, sleeping only when
-a pass did no work.
+embeddable in tests — that *prods* every registered prodable (ZStacks,
+nodes) and then services the shared QueueTimer each pass, sleeping only
+when a pass did no work.
+
+Pump order IS the deployed node's dispatch-plane barrier (README
+"Performance"): transports drain first — every pending socket read lands
+in its handlers (signed ingress into the auth queue, votes recorded
+host-side) — and only then do due timer events fire. A barrier-scheduled
+quorum tick (``Node._quorum_tick``) therefore always observes a fully
+drained transport, exactly like the simulation's tick observes a drained
+delivery set: drain → scatter → single grouped step → read events holds
+over real zstack sockets too.
 
 A raising prodable/timer callback is logged and isolated (the reference
 Looper's per-prodable error guard): one faulty component must not stall
@@ -61,11 +70,10 @@ class Looper:
 
     def _pump_once(self) -> int:
         worked = 0
-        try:
-            worked += self.timer.service()
-        except Exception:  # noqa: BLE001 — isolate faulty callbacks
-            logger.exception("timer callback raised")
-            self.errors += 1
+        # transports BEFORE timers (the zstack transport barrier): a due
+        # quorum tick must fire against a drained socket set — reads that
+        # were already pending when the tick came due land first, so the
+        # tick's one device step carries them instead of the next tick's
         for prodable in list(self._prodables):
             try:
                 fn = getattr(prodable, "prod", None) or prodable.service
@@ -73,6 +81,11 @@ class Looper:
             except Exception:  # noqa: BLE001
                 logger.exception("prodable %r raised", prodable)
                 self.errors += 1
+        try:
+            worked += self.timer.service()
+        except Exception:  # noqa: BLE001 — isolate faulty callbacks
+            logger.exception("timer callback raised")
+            self.errors += 1
         return worked
 
     def run_for(self, seconds: float) -> None:

@@ -36,6 +36,13 @@ class MetricsName:
     # the votes-per-tick / padded-shape ratio (see README "Performance").
     DEVICE_DISPATCHES_PER_TICK = "device.dispatches_per_tick"
     DEVICE_FLUSH_OCCUPANCY = "device.flush_occupancy"
+    # dispatch governor (adaptive tick, tpu/governor.py): the effective
+    # interval after every tick (Stat.last = the CURRENT interval; the
+    # histogram records how long the pool dwelt on each rung) and the
+    # occupancy EWMA the control law acted on — together they make an
+    # adaptive run's trajectory a comparable, replayable artifact
+    GOVERNOR_TICK_INTERVAL = "governor.tick_interval"
+    GOVERNOR_OCCUPANCY_EWMA = "governor.occupancy_ewma"
     # execution
     COMMIT_TIME = "exec.commit_time"
     # catchup
@@ -49,19 +56,23 @@ class MetricsName:
 
 
 class Stat:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "last")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # most recent value: for control variables (the governor's tick
+        # interval) "current" is the question dashboards ask
+        self.last: Optional[float] = None
 
     def add(self, value: float) -> None:
         self.count += 1
         self.total += value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
+        self.last = value
 
     @property
     def avg(self) -> float:
@@ -69,18 +80,41 @@ class Stat:
 
     def as_dict(self) -> Dict[str, Any]:
         return {"count": self.count, "sum": self.total, "avg": self.avg,
-                "min": self.min, "max": self.max}
+                "min": self.min, "max": self.max, "last": self.last}
+
+
+# distinct buckets kept per histogram: control variables take few values
+# (the governor's ladder is multiplicative steps inside fixed bounds), so
+# overflow means a bug upstream — excess lands in one "other" bucket
+# instead of growing without bound
+HISTOGRAM_MAX_BUCKETS = 64
+HISTOGRAM_OVERFLOW_KEY = "other"
 
 
 class MetricsCollector:
     def __init__(self):
         self._stats: Dict[str, Stat] = {}
+        self._histograms: Dict[str, Dict[Any, int]] = {}
 
     def add_event(self, name: str, value: float = 1.0) -> None:
         stat = self._stats.get(name)
         if stat is None:
             stat = self._stats[name] = Stat()
         stat.add(value)
+
+    def add_to_histogram(self, name: str, bucket: Any) -> None:
+        """Count ``bucket`` occurrences under ``name`` (bounded: at most
+        HISTOGRAM_MAX_BUCKETS distinct buckets, then "other")."""
+        hist = self._histograms.get(name)
+        if hist is None:
+            hist = self._histograms[name] = {}
+        if bucket not in hist and len(hist) >= HISTOGRAM_MAX_BUCKETS:
+            bucket = HISTOGRAM_OVERFLOW_KEY
+        hist[bucket] = hist.get(bucket, 0) + 1
+
+    def histogram(self, name: str) -> Optional[Dict[Any, int]]:
+        hist = self._histograms.get(name)
+        return dict(hist) if hist is not None else None
 
     def stat(self, name: str) -> Optional[Stat]:
         return self._stats.get(name)
@@ -101,6 +135,9 @@ class NullMetricsCollector(MetricsCollector):
     """Zero-cost sink for compositions that don't collect."""
 
     def add_event(self, name: str, value: float = 1.0) -> None:
+        pass
+
+    def add_to_histogram(self, name: str, bucket: Any) -> None:
         pass
 
     @contextmanager
@@ -126,6 +163,7 @@ class KvMetricsCollector(MetricsCollector):
             stat.total = snap.get("sum", 0.0)
             stat.min = snap.get("min")
             stat.max = snap.get("max")
+            stat.last = snap.get("last")
 
     def add_event(self, name: str, value: float = 1.0) -> None:
         super().add_event(name, value)

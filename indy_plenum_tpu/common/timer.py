@@ -153,7 +153,15 @@ class RepeatingTimer:
                 self._timer.cancel(self._pending)
                 self._pending = None
 
+    @property
+    def interval(self) -> float:
+        """The CURRENT interval (the dispatch governor retunes it live)."""
+        return self._interval
+
     def update_interval(self, interval: float) -> None:
+        """Takes effect at the next (re)schedule: calling this from inside
+        the callback — the governor's pattern — retimes the very next
+        occurrence, because _fire reschedules after the callback returns."""
         if interval <= 0:
             raise ValueError("interval must be positive")
         self._interval = interval

@@ -92,6 +92,21 @@ class Config:
     # tick so all votes recorded in between ride ONE device flush
     # (vote_plane.py's batching contract; the Node event-loop mode).
     QuorumTickInterval: float = 0.0
+    # Adaptive tick (dispatch governor, tpu/governor.py): the tick
+    # interval becomes a closed-loop control variable — widened while the
+    # observed flush occupancy is sparse (fewer near-empty scatters),
+    # narrowed while a tick overflows one grouped step or runs hot
+    # (lower quorum latency at no extra dispatch cost). The controller is
+    # a pure function of the per-tick metrics, so seeded runs (incl.
+    # chaos) replay to the identical interval trajectory.
+    QuorumTickAdaptive: bool = False
+    QuorumTickIntervalMin: float = 0.0  # 0 -> QuorumTickInterval / 4
+    QuorumTickIntervalMax: float = 0.0  # 0 -> QuorumTickInterval * 4
+    GovernorEwmaAlpha: float = 0.3  # weight of the newest tick's occupancy
+    GovernorOccupancyLow: float = 0.02  # EWMA below this widens the tick
+    GovernorOccupancyHigh: float = 0.85  # EWMA above this narrows it
+    GovernorWiden: float = 1.5  # multiplicative widen step
+    GovernorNarrow: float = 0.5  # multiplicative narrow step
 
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory
@@ -123,6 +138,15 @@ class Config:
     # --- misc -------------------------------------------------------------
     NETWORK_NAME: str = "sandbox"
     replicas_count_overrider: Optional[int] = None  # else f+1
+
+    def governor_bounds(self) -> Tuple[float, float]:
+        """Resolved (min, max) tick bounds for the adaptive governor; the
+        0.0 defaults scale off the base interval so one knob still tunes
+        a pool."""
+        base = self.QuorumTickInterval
+        lo = self.QuorumTickIntervalMin or base / 4.0
+        hi = self.QuorumTickIntervalMax or base * 4.0
+        return lo, hi
 
     def replicas_count(self, n_nodes: int) -> int:
         if self.replicas_count_overrider is not None:

@@ -415,8 +415,36 @@ class ZStack:
                     if self.on_connection_change is not None:
                         self.on_connection_change(peer, up)
 
+    def drain_inbound(self) -> int:
+        """Drain EVERY pending socket read and dispatch it (the
+        dispatch-plane drain step over real sockets): loops until the
+        listener reports empty, so when this returns the composition
+        holds the COMPLETE inbound set — signed ingress in the auth
+        queue, votes recorded host-side. The Looper prods transports
+        before servicing timers, so a barrier quorum tick always fires
+        against a drained transport (one grouped device step then covers
+        everything that arrived during the interval)."""
+        handled = 0
+        while True:
+            try:
+                frames = self._listener.recv_multipart(
+                    flags=zmq.NOBLOCK, copy=False)
+            except zmq.Again:
+                break
+            payload = frames[-1]
+            sender = self._sender_of(payload)
+            if sender is None:
+                continue  # unauthenticated — ZAP metadata missing
+            self._dispatch(bytes(payload.buffer), sender)
+            handled += 1
+        return handled
+
     def service(self, timeout_ms: int = 0) -> int:
-        """Pump ZAP + inbound + outbound once; returns messages handled."""
+        """Pump ZAP + inbound + outbound once; returns messages handled.
+
+        Order per pass: handshakes (ZAP) and liveness edges first, then a
+        FULL inbound drain (:meth:`drain_inbound` — the tick contract's
+        drain step), then the coalesced outbound flush."""
         handled = 0
         events = dict(self._poller.poll(timeout_ms))
         if self._zap in events:
@@ -424,18 +452,7 @@ class ZStack:
         self._service_monitors(events)
         self._retry_dead_connections()
         if self._listener in events:
-            while True:
-                try:
-                    frames = self._listener.recv_multipart(
-                        flags=zmq.NOBLOCK, copy=False)
-                except zmq.Again:
-                    break
-                payload = frames[-1]
-                sender = self._sender_of(payload)
-                if sender is None:
-                    continue  # unauthenticated — ZAP metadata missing
-                self._dispatch(bytes(payload.buffer), sender)
-                handled += 1
+            handled += self.drain_inbound()
         self._flush()
         return handled
 

@@ -147,6 +147,22 @@ class Monitor:
                 if stat is not None:
                     device[label] = {"count": stat.count,
                                      "avg": round(stat.avg, 4)}
+            # adaptive tick (dispatch governor): the CURRENT effective
+            # interval plus the dwell histogram — how the pool's tick
+            # travelled between its bounds this run
+            tick = self._metrics.stat(MetricsName.GOVERNOR_TICK_INTERVAL)
+            if tick is not None:
+                device["tick_interval"] = {
+                    "current": tick.last,
+                    "min": tick.min,
+                    "max": tick.max,
+                    "histogram": self._metrics.histogram(
+                        MetricsName.GOVERNOR_TICK_INTERVAL),
+                }
+                ewma = self._metrics.stat(
+                    MetricsName.GOVERNOR_OCCUPANCY_EWMA)
+                if ewma is not None:
+                    device["occupancy_ewma"] = round(ewma.last, 4)
             if device:
                 snap["device_dispatch"] = device
         return snap

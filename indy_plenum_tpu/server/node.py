@@ -352,15 +352,27 @@ class Node:
             timer, self.config.PropagateBatchWait, self._flush_auth_queue,
             active=False)
         # tick-batched quorum mode for a standalone vote plane; a pool
-        # composition that shares a grouped plane drives ticks itself
+        # composition that shares a grouped plane drives ticks itself.
+        # Over the zstack transport this tick is the deployed node's
+        # dispatch barrier: the Looper drains every pending socket read
+        # (handlers enqueue signed ingress + record votes) BEFORE timer
+        # events fire, so the tick always evaluates a drained transport.
         self._quorum_tick_timer = None
+        self._dispatch_governor = None
         if (drive_quorum_ticks and vote_plane is not None
                 and self.config.QuorumTickInterval > 0):
             vote_plane.defer_flush_on_query = True
+            from ..tpu.governor import DispatchGovernor
+
+            self._dispatch_governor = DispatchGovernor.from_config(
+                self.config, metrics=self.metrics)
+            interval = (self._dispatch_governor.interval
+                        if self._dispatch_governor
+                        else self.config.QuorumTickInterval)
             # barrier: deliveries due at the tick instant drain first, so
             # the tick evaluates a complete delivery set (dispatch plane)
             self._quorum_tick_timer = RepeatingTimer(
-                timer, self.config.QuorumTickInterval, self._quorum_tick,
+                timer, interval, self._quorum_tick,
                 active=False, barrier=True)
         self.vote_plane = vote_plane
 
@@ -406,10 +418,18 @@ class Node:
         # device auth batch), scatter buffered votes (one grouped device
         # step), then evaluate quorums against the fresh snapshot
         self._flush_auth_queue()
-        before = self.vote_plane.flushes
-        self.vote_plane.sync()
+        plane = self.vote_plane
+        before = (plane.flushes, plane.flush_votes_total,
+                  plane.flush_capacity_total)
+        plane.sync()
+        dispatches = plane.flushes - before[0]
         self.metrics.add_event(MetricsName.DEVICE_DISPATCHES_PER_TICK,
-                               self.vote_plane.flushes - before)
+                               dispatches)
+        if self._dispatch_governor is not None:
+            self._quorum_tick_timer.update_interval(
+                self._dispatch_governor.observe(
+                    plane.flush_votes_total - before[1],
+                    plane.flush_capacity_total - before[2], dispatches))
         self.ordering.service_quorum_tick()
         self.checkpoints.service_quorum_tick()
         for backup in self.replicas.backups:

@@ -138,6 +138,7 @@ class NodePool:
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,
             ingress=drain_auth_queues)
+        self.governor = getattr(self._quorum_tick_timer, "governor", None)
 
         self._req_seq = 0
 

@@ -500,6 +500,9 @@ class SimPool:
             accounting=self.host_seconds,
             ingress=(self.flush_ingress if self.authnr is not None
                      else None))
+        # adaptive tick mode: the governor's interval trajectory is a
+        # first-class observable (bench digests, determinism tests)
+        self.governor = getattr(self._quorum_tick_timer, "governor", None)
 
     def _install_accounting(self, node: "SimNode") -> None:
         import time as _time

@@ -62,6 +62,13 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
     timers. ``accounting`` (name -> seconds) attributes each node's
     tick evaluation to it, plus the FULL shared flush time to EVERY node
     (conservative: a deployed node flushes only its own plane).
+
+    With ``config.QuorumTickAdaptive`` the returned timer's interval is
+    governed: after each tick the :class:`~indy_plenum_tpu.tpu.governor
+    .DispatchGovernor` observes the tick's scattered votes / padded
+    capacity / chained dispatches and retunes the interval inside the
+    configured bounds (the governor rides the timer as ``.governor`` so
+    pools can expose the trajectory).
     """
     if vote_group is None or config.QuorumTickInterval <= 0:
         return None
@@ -70,7 +77,13 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
 
     from time import perf_counter
 
-    last_flushes = [vote_group.flushes]
+    from ..tpu.governor import DispatchGovernor
+
+    governor = DispatchGovernor.from_config(config,
+                                            metrics=vote_group.metrics)
+    last = [vote_group.flushes, vote_group.flush_votes_total,
+            vote_group.flush_capacity_total]
+    timer_box: list = []  # the RepeatingTimer, bound after construction
 
     def tick() -> None:
         # ingress stays OUTSIDE the accounted window: SimPool's shared
@@ -80,10 +93,16 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
             ingress()
         t0 = perf_counter() if accounting is not None else 0.0
         vote_group.flush()
+        dispatches = vote_group.flushes - last[0]
         vote_group.metrics.add_event(
-            MetricsName.DEVICE_DISPATCHES_PER_TICK,
-            vote_group.flushes - last_flushes[0])
-        last_flushes[0] = vote_group.flushes
+            MetricsName.DEVICE_DISPATCHES_PER_TICK, dispatches)
+        if governor is not None:
+            new_interval = governor.observe(
+                vote_group.flush_votes_total - last[1],
+                vote_group.flush_capacity_total - last[2], dispatches)
+            timer_box[0].update_interval(new_interval)
+        last[:] = [vote_group.flushes, vote_group.flush_votes_total,
+                   vote_group.flush_capacity_total]
         flush_dt = perf_counter() - t0 if accounting is not None else 0.0
         for node in nodes:
             t0 = perf_counter() if accounting is not None else 0.0
@@ -97,5 +116,8 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
             if accounting is not None:
                 accounting[node.name] += (perf_counter() - t0) + flush_dt
 
-    return RepeatingTimer(timer, config.QuorumTickInterval, tick,
-                          barrier=True)
+    interval = governor.interval if governor else config.QuorumTickInterval
+    rt = RepeatingTimer(timer, interval, tick, barrier=True)
+    timer_box.append(rt)
+    rt.governor = governor
+    return rt

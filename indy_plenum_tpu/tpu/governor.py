@@ -1,0 +1,156 @@
+"""Dispatch governor: occupancy-driven closed-loop control of the tick.
+
+PR 2 froze the dispatch plane's tick at a static ``QuorumTickInterval``.
+That interval is a throughput/latency dial with no single right setting:
+too wide and a 3PC wave waits most of a tick for its quorum verdicts
+(Max3PCBatchesInFlight stalls the pipeline); too narrow and an idle or
+trickling pool pays a near-empty padded scatter per tick. RBFT's
+throughput case (Aublin et al., ICDCS 2013) and the pipelined-BFT designs
+(HotStuff, PODC 2019) both point the same way: the win is keeping device
+and host phases overlapped WITHOUT paying per-message dispatch — which
+makes the tick interval a control variable, not a constant.
+
+:class:`DispatchGovernor` closes the loop over the metrics the dispatch
+plane already measures (``device.flush_occupancy``,
+``device.dispatches_per_tick``):
+
+- **narrow** while a tick chains more than one grouped step (its votes
+  overflowed the top ``FLUSH_LADDER`` rung — splitting the same votes
+  across more ticks costs no extra dispatches and cuts quorum latency),
+  or while the occupancy EWMA runs above ``GovernorOccupancyHigh``;
+- **widen** while the EWMA sits below ``GovernorOccupancyLow`` (sparse
+  ticks: a wider tick coalesces the same trickle of votes into fewer,
+  fuller scatters);
+- **hold** in between.
+
+The equilibrium is the dispatch plane's own contract: one tick ≈ one
+grouped device step, as full as the workload allows.
+
+Determinism: ``observe`` is a pure function of the metric sequence (EWMA
+state + multiplicative steps clamped to configured bounds — no wall
+clock, no randomness), so a seeded run (including chaos-scheduled fault
+runs) replays to the *identical* interval trajectory. The trajectory is
+itself an artifact: every observation lands in the metrics collector
+(``governor.tick_interval`` stat + histogram, ``governor.occupancy_ewma``)
+and in :attr:`trajectory` for bench/report digests.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from ..common.metrics_collector import MetricsCollector, MetricsName
+
+# retained trajectory window: full fidelity for any bench/test-sized run,
+# bounded for a deployed node governing ticks for days (at the default
+# floor of base/4 this is hours of history; the running min/max and the
+# metrics stat/histogram keep whole-run aggregates exact)
+TRAJECTORY_WINDOW = 65536
+
+
+class DispatchGovernor:
+    """Deterministic EWMA controller for the quorum tick interval."""
+
+    def __init__(self, interval: float, min_interval: float,
+                 max_interval: float, alpha: float = 0.3,
+                 occupancy_low: float = 0.02, occupancy_high: float = 0.85,
+                 widen: float = 1.5, narrow: float = 0.5,
+                 metrics: Optional[MetricsCollector] = None):
+        if not (0.0 < min_interval <= max_interval):
+            raise ValueError(
+                f"bad governor bounds [{min_interval}, {max_interval}]")
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"alpha must be in (0, 1]: {alpha}")
+        if widen <= 1.0 or not (0.0 < narrow < 1.0):
+            raise ValueError(f"bad step factors widen={widen} narrow={narrow}")
+        self.min_interval = float(min_interval)
+        self.max_interval = float(max_interval)
+        self.interval = min(max(float(interval), self.min_interval),
+                            self.max_interval)
+        self.alpha = float(alpha)
+        self.occupancy_low = float(occupancy_low)
+        self.occupancy_high = float(occupancy_high)
+        self.widen = float(widen)
+        self.narrow = float(narrow)
+        self.ewma: Optional[float] = None  # occupancy EWMA (None = cold)
+        self.ticks = 0
+        # interval AFTER each observation (bounded recent window); the
+        # running extremes below stay exact over the whole run
+        self.trajectory: "deque[float]" = deque(maxlen=TRAJECTORY_WINDOW)
+        self._interval_low: Optional[float] = None
+        self._interval_high: Optional[float] = None
+        self.metrics = metrics if metrics is not None else MetricsCollector()
+
+    # ------------------------------------------------------------------
+
+    def observe(self, votes: int, capacity: int, dispatches: int) -> float:
+        """Feed one tick's measurements; returns the interval for the NEXT
+        tick. ``votes``/``capacity`` are the tick's scattered vote count
+        and padded scatter capacity (0/0 for an idle tick — occupancy 0,
+        which is what lets an idle pool widen); ``dispatches`` is how many
+        grouped device steps the tick chained."""
+        occupancy = votes / capacity if capacity > 0 else 0.0
+        if self.ewma is None:
+            self.ewma = occupancy
+        else:
+            self.ewma = (self.alpha * occupancy
+                         + (1.0 - self.alpha) * self.ewma)
+        if dispatches > 1 or self.ewma >= self.occupancy_high:
+            self.interval = max(self.interval * self.narrow,
+                                self.min_interval)
+        elif self.ewma <= self.occupancy_low:
+            self.interval = min(self.interval * self.widen,
+                                self.max_interval)
+        self.ticks += 1
+        self.trajectory.append(self.interval)
+        if self._interval_low is None or self.interval < self._interval_low:
+            self._interval_low = self.interval
+        if self._interval_high is None or self.interval > self._interval_high:
+            self._interval_high = self.interval
+        self.metrics.add_event(MetricsName.GOVERNOR_TICK_INTERVAL,
+                               self.interval)
+        self.metrics.add_to_histogram(MetricsName.GOVERNOR_TICK_INTERVAL,
+                                      round(self.interval, 6))
+        self.metrics.add_event(MetricsName.GOVERNOR_OCCUPANCY_EWMA,
+                               self.ewma)
+        return self.interval
+
+    # ------------------------------------------------------------------
+
+    def trajectory_summary(self) -> dict:
+        """The bench/report digest: where the interval travelled (exact
+        whole-run extremes; median over the retained window) and where
+        the occupancy EWMA settled."""
+        if not self.trajectory:
+            return {"ticks": 0, "interval_min": self.interval,
+                    "interval_median": self.interval,
+                    "interval_max": self.interval,
+                    "occupancy_ewma": self.ewma}
+        ordered = sorted(self.trajectory)
+        mid = len(ordered) // 2
+        median = ordered[mid] if len(ordered) % 2 else (
+            ordered[mid - 1] + ordered[mid]) / 2.0
+        return {
+            "ticks": self.ticks,
+            "interval_min": round(self._interval_low, 6),
+            "interval_median": round(median, 6),
+            "interval_max": round(self._interval_high, 6),
+            "occupancy_ewma": (round(self.ewma, 6)
+                               if self.ewma is not None else None),
+        }
+
+    @classmethod
+    def from_config(cls, config, metrics: Optional[MetricsCollector] = None
+                    ) -> Optional["DispatchGovernor"]:
+        """The single wiring point for every tick driver (quorum_driver,
+        Node._quorum_tick): None unless tick-batched AND adaptive."""
+        if config.QuorumTickInterval <= 0 or not config.QuorumTickAdaptive:
+            return None
+        lo, hi = config.governor_bounds()
+        return cls(config.QuorumTickInterval, lo, hi,
+                   alpha=config.GovernorEwmaAlpha,
+                   occupancy_low=config.GovernorOccupancyLow,
+                   occupancy_high=config.GovernorOccupancyHigh,
+                   widen=config.GovernorWiden,
+                   narrow=config.GovernorNarrow,
+                   metrics=metrics)

@@ -62,14 +62,39 @@ def ladder_shape(n_votes: int) -> int:
     return FLUSH_BATCH
 
 
+# double-buffered device steps: donate the state operand so XLA writes
+# the step's output state INTO the input's buffers (no state-sized
+# alloc+copy per dispatch) while the freshly packed words ride their own
+# host buffer — dispatch is async, so the device consumes buffer N while
+# the host packs N+1. Every caller rebinds the state reference on return,
+# which is exactly what donation requires. XLA:CPU doesn't implement
+# donation (it would warn once per compile and ignore it), so gate it —
+# but probe the backend LAZILY, at the first dispatch: probing at import
+# would initialize the JAX backend before consumers (tests/conftest.py,
+# any host-only code path) get to configure jax_platforms.
+@functools.lru_cache(maxsize=None)
+def _state_donation() -> tuple:
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def _step(state: q.VoteState, msgs: q.MsgBatch, n_validators: int):
     return q.step(state, msgs, n_validators)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _step_words(state: q.VoteState, words, n_validators: int):
+@functools.lru_cache(maxsize=None)
+def _jit_step_words():
+    return functools.partial(
+        jax.jit, static_argnums=(2,),
+        donate_argnums=_state_donation())(_step_words_impl)
+
+
+def _step_words_impl(state: q.VoteState, words, n_validators: int):
     return q.step(state, q.unpack_words(words), n_validators)
+
+
+def _step_words(state: q.VoteState, words, n_validators: int):
+    return _jit_step_words()(state, words, n_validators)
 
 
 def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
@@ -105,13 +130,25 @@ def _group_step(states: q.VoteState, msgs: q.MsgBatch, n_validators: int):
     return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@functools.lru_cache(maxsize=None)
+def _jit_group_step_words():
+    return functools.partial(
+        jax.jit, static_argnums=(2,),
+        donate_argnums=_state_donation())(_group_step_words_impl)
+
+
+def _group_step_words_impl(states: q.VoteState, words, n_validators: int):
+    msgs = q.unpack_words(words)
+    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+
+
 def _group_step_words(states: q.VoteState, words, n_validators: int):
     """Group step over word-packed votes: the (M, B) uint32 operand is a
     quarter the bytes of a MsgBatch — the host->device transfer is the
-    blocking cost of a flush, so this is the wire format for groups."""
-    msgs = q.unpack_words(words)
-    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+    blocking cost of a flush, so this is the wire format for groups. The
+    states operand is donated (see _state_donation): tick N's output
+    state lands in tick N-1's buffers while the host packs tick N+1."""
+    return _jit_group_step_words()(states, words, n_validators)
 
 
 @jax.jit
@@ -145,6 +182,11 @@ class DeviceVotePlane:
         self._host_commit_counts: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
         self.flushes = 0
+        # cumulative scattered votes and padded scatter capacity: the
+        # occupancy signal the dispatch governor closes its loop over
+        # (per-tick deltas of these two counters)
+        self.flush_votes_total = 0
+        self.flush_capacity_total = 0
         # tick-batched mode: quorum queries read the last-synced snapshot
         # instead of flushing per query. There is NO built-in driver: the
         # runtime composition that sets this flag must call sync() (or, in
@@ -250,10 +292,13 @@ class DeviceVotePlane:
         while self._pending:
             chunk, self._pending = (self._pending[:FLUSH_BATCH],
                                     self._pending[FLUSH_BATCH:])
-            words = jnp.asarray(q.words_row(chunk, ladder_shape(len(chunk))))
+            shape = ladder_shape(len(chunk))
+            words = jnp.asarray(q.words_row(chunk, shape))
             self._state, self._events = _step_words(
                 self._state, words, self._n)
             self.flushes += 1
+            self.flush_votes_total += len(chunk)
+            self.flush_capacity_total += shape
 
     def _refresh(self) -> None:
         self._flush()
@@ -261,6 +306,11 @@ class DeviceVotePlane:
             self._state, self._events = _step_words(
                 self._state, jnp.asarray(q.words_row([], FLUSH_LADDER[0])),
                 self._n)
+            # a real device dispatch: count it like any other flush, or
+            # the governor (and the dispatch budget) would see a post-
+            # reset tick as free
+            self.flushes += 1
+            self.flush_capacity_total += FLUSH_LADDER[0]
         (self._host_prepared, self._host_prepare_counts,
          self._host_commit_counts, self._host_stable) = jax.device_get(
             (self._events.prepared, self._events.prepare_counts,
@@ -300,23 +350,6 @@ class DeviceVotePlane:
             return 0
         self.events()
         return int(self._host_prepare_counts[slot])
-
-
-def _pack_group_words(chunks: List[List[int]], max_batch: int
-                      ) -> jnp.ndarray:
-    """(M lists of pre-packed vote words) -> one (M, B) uint32 array.
-
-    One vectorized row write per member (a dense-pool tick flushes tens
-    of thousands of votes) and one word per vote on the wire — the
-    host->device transfer is the blocking cost of a flush."""
-    # entries are pre-packed words (q.vote_word at record time): the rows
-    # land straight in the final (M, B) buffer — no per-member row array,
-    # no stack copy, no MsgBatch struct re-materialized anywhere host-side
-    out = np.zeros((len(chunks), max_batch), np.uint32)
-    for i, entries in enumerate(chunks):
-        if entries:
-            q.fill_words_row(out[i], entries)
-    return jnp.asarray(out)
 
 
 class VotePlaneGroup:
@@ -370,6 +403,19 @@ class VotePlaneGroup:
         self._host_commit_counts: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
         self.flushes = 0
+        # occupancy counters (see DeviceVotePlane): per-tick deltas feed
+        # the dispatch governor
+        self.flush_votes_total = 0
+        self.flush_capacity_total = 0
+        # reusable host scatter staging: one preallocated (M, B) buffer
+        # per ladder rung — the hot loop stops paying an (M, B) np.zeros
+        # allocation per flush. Reuse is safe ONLY because the device
+        # hand-off is a forced copy (jnp.array, never jnp.asarray): on
+        # jax 0.4.37's CPU backend asarray zero-copies suitably aligned
+        # numpy buffers (allocator luck, reproduced empirically), and an
+        # aliased buffer reused across `_dispatch_pending`'s chained
+        # async dispatches would silently corrupt in-flight vote words.
+        self._scatter_bufs: dict = {}  # rung -> (M, rung) staging buffer
         # device placement must be justifiable with data: flush count,
         # latency and votes-per-flush land here (injectable for a shared
         # or null collector)
@@ -409,6 +455,22 @@ class VotePlaneGroup:
         snapshot (pipelined mode) — quorum state may be newer on device."""
         return self._inflight is not None
 
+    def _stage_scatter(self, chunks: List[List[int]], shape: int):
+        """Pack ``chunks`` into the rung's reusable host buffer and hand
+        the device its own copy (one vectorized row write per member;
+        the staging buffer itself is never reallocated)."""
+        out = self._scatter_bufs.get(shape)
+        if out is None:
+            out = self._scatter_bufs[shape] = np.zeros(
+                (len(self._members), shape), np.uint32)
+        out[...] = 0
+        for i, entries in enumerate(chunks):
+            if entries:
+                q.fill_words_row(out[i], entries)
+        # forced copy — see the staging-buffer comment in __init__ for
+        # why asarray would alias and corrupt in-flight dispatches
+        return jnp.array(out)
+
     def _dispatch_pending(self):
         """Chunk + scatter every member's pending votes (async dispatch);
         returns the LAST chained step's events (they reflect every vote
@@ -426,24 +488,27 @@ class VotePlaneGroup:
             # (a few straggler votes) scatters 16-wide, a full protocol
             # wave 128-wide — each rung is one cached XLA compilation
             shape = ladder_shape(max(len(c) for c in chunks))
-            words = self._place(_pack_group_words(chunks, shape))
+            words = self._place(self._stage_scatter(chunks, shape))
             self._states, events = _group_step_words(
                 self._states, words, self._n)
             self.flushes += 1
+            capacity = len(self._members) * shape
+            self.flush_votes_total += votes
+            self.flush_capacity_total += capacity
             self.metrics.add_event(MetricsName.DEVICE_FLUSH)
             self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
             self.metrics.add_event(
-                MetricsName.DEVICE_FLUSH_OCCUPANCY,
-                votes / (len(self._members) * shape))
+                MetricsName.DEVICE_FLUSH_OCCUPANCY, votes / capacity)
         return events
 
     def _dispatch_empty(self):
         """One padded no-vote step (cold start needs SOME events)."""
-        words = self._place(_pack_group_words(
+        words = self._place(self._stage_scatter(
             [[] for _ in self._members], FLUSH_LADDER[0]))
         self._states, events = _group_step_words(
             self._states, words, self._n)
         self.flushes += 1
+        self.flush_capacity_total += len(self._members) * FLUSH_LADDER[0]
         self.metrics.add_event(MetricsName.DEVICE_FLUSH)
         return events
 
@@ -548,6 +613,25 @@ class _MemberPlane(DeviceVotePlane):
 
     @flushes.setter
     def flushes(self, value) -> None:  # base-class compat; group owns it
+        pass
+
+    # occupancy counters live on the group (shared dispatches); read-only
+    # views keep the DeviceVotePlane interface uniform for tick drivers
+
+    @property
+    def flush_votes_total(self) -> int:
+        return self._group.flush_votes_total
+
+    @flush_votes_total.setter
+    def flush_votes_total(self, value) -> None:
+        pass
+
+    @property
+    def flush_capacity_total(self) -> int:
+        return self._group.flush_capacity_total
+
+    @flush_capacity_total.setter
+    def flush_capacity_total(self, value) -> None:
         pass
 
     @property

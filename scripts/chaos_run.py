@@ -47,9 +47,16 @@ def main() -> int:
                         help="QuorumTickInterval: > 0 routes the scenario "
                              "through the tick-batched dispatch plane "
                              "(requires --device-quorum)")
+    parser.add_argument("--adaptive-tick", action="store_true",
+                        help="hand the tick to the dispatch governor "
+                             "(requires --tick; the report's "
+                             "governor.tick_interval metrics record the "
+                             "deterministic interval trajectory)")
     args = parser.parse_args()
     if args.tick > 0 and not args.device_quorum:
         parser.error("--tick requires --device-quorum")
+    if args.adaptive_tick and args.tick <= 0:
+        parser.error("--adaptive-tick requires --tick")
 
     if args.list:
         for name in sorted(SCENARIOS):
@@ -63,7 +70,8 @@ def main() -> int:
     report = run_scenario(args.scenario, seed=args.seed,
                           n_nodes=args.nodes, out_path=out,
                           device_quorum=args.device_quorum,
-                          quorum_tick_interval=args.tick)
+                          quorum_tick_interval=args.tick,
+                          quorum_tick_adaptive=args.adaptive_tick)
     for line in report.summary_lines():
         print(line)
     print(f"  report: {out}")

@@ -6,6 +6,18 @@ dispatches per delivered message). Exit status 1 if either exceeds its
 budget — callable from the bench loop, chaos runs, or CI, so a regression
 that quietly reverts to per-message flushing turns red instead of slow.
 
+Governor gates (PR 3): unless ``--no-governor-gates``, the script ALSO
+runs a bursty profile (burst → trickle → burst — the load shape the
+adaptive tick exists for) twice, static vs adaptive, and fails if
+
+- the adaptive run's steady-state ``device.flush_occupancy`` falls below
+  ``--occupancy-floor`` (the governor must keep scatters usefully full),
+- the adaptive run regresses ``device_dispatches_per_ordered_batch``
+  beyond ``--adaptive-tolerance`` of the static-tick run, or
+- the adaptive run orders fewer txns per *sim* second than the static
+  run allows after ``--adaptive-tolerance`` slack (the governor must not
+  trade dispatches for protocol-time throughput).
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
@@ -29,8 +41,31 @@ from indy_plenum_tpu.config import getConfig  # noqa: E402
 from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
 
 
+def _submit_bursty(pool, target: int) -> None:
+    """Burst → trickle → burst: a third of the load lands at t=0, a third
+    trickles one request per 0.25 sim-seconds (sparse ticks — the regime
+    the governor widens for), and the rest bursts after the trickle
+    (saturation — the regime it narrows for). Deterministic: everything
+    rides the pool's virtual timer."""
+    seq = [0]
+
+    def submit(count: int) -> None:
+        for _ in range(count):
+            pool.submit_request(seq[0])
+            seq[0] += 1
+
+    burst = max(1, target // 3)
+    trickle = max(0, target - 2 * burst)
+    submit(burst)
+    for i in range(trickle):
+        pool.timer.schedule(2.0 + i * 0.25, lambda: submit(1))
+    pool.timer.schedule(2.0 + trickle * 0.25 + 2.0,
+                        lambda: submit(target - burst - trickle))
+
+
 def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
-            tick_interval: float, seed: int = 11) -> dict:
+            tick_interval: float, seed: int = 11, adaptive: bool = False,
+            bursty: bool = False) -> dict:
     """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
     measurement: the gate counts every dispatch from pool construction on
     (cold-start/compile steps included), because the budget protects the
@@ -40,6 +75,7 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         "Max3PCBatchSize": batch_size,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": tick_interval,
+        "QuorumTickAdaptive": adaptive,
     })
     pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
                    device_quorum=True, shadow_check=False,
@@ -49,21 +85,28 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         return min(len(nd.ordered_digests) for nd in pool.nodes)
 
     target = batches * batch_size
-    for i in range(target):
-        pool.submit_request(i)
+    sim_t0 = pool.timer.get_current_time()
+    if bursty:
+        _submit_bursty(pool, target)
+    else:
+        for i in range(target):
+            pool.submit_request(i)
     deadline = time.monotonic() + 240
     while min_ordered() < target and time.monotonic() < deadline:
         pool.run_for(0.5)
     assert min_ordered() >= target, f"stalled at {min_ordered()}/{target}"
     assert pool.honest_nodes_agree()
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
 
     dispatches = pool.vote_group.flushes
     delivered = pool.network.sent
     occ = pool.metrics.stat(MetricsName.DEVICE_FLUSH_OCCUPANCY)
     per_tick = pool.metrics.stat(MetricsName.DEVICE_DISPATCHES_PER_TICK)
-    return {
+    result = {
         "n_nodes": n_nodes,
         "instances": instances,
+        "adaptive": adaptive,
+        "bursty": bursty,
         "txns_ordered": min_ordered(),
         "ordered_batches": batches,
         "device_dispatches": dispatches,
@@ -74,7 +117,49 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
             dispatches / delivered, 4) if delivered else 0.0,
         "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
         "dispatches_per_tick_max": per_tick.max if per_tick else None,
+        "ordered_per_sim_second": round(target / sim_elapsed, 2)
+        if sim_elapsed else None,
     }
+    if pool.governor is not None:
+        result["governor"] = pool.governor.trajectory_summary()
+    return result
+
+
+def governor_gates(args) -> "tuple[dict, list]":
+    """Static vs adaptive on the SAME bursty workload and seed; returns
+    (record, failures)."""
+    static = measure(args.nodes, args.instances, args.batches,
+                     args.batch_size, args.tick, seed=args.seed,
+                     adaptive=False, bursty=True)
+    adaptive = measure(args.nodes, args.instances, args.batches,
+                       args.batch_size, args.tick, seed=args.seed,
+                       adaptive=True, bursty=True)
+    tol = args.adaptive_tolerance
+    failures = []
+    occ = adaptive["flush_occupancy_avg"] or 0.0
+    if occ < args.occupancy_floor:
+        failures.append(
+            f"adaptive flush_occupancy {occ} < floor {args.occupancy_floor}")
+    s_pb = static["device_dispatches_per_ordered_batch"]
+    a_pb = adaptive["device_dispatches_per_ordered_batch"]
+    if a_pb > s_pb * (1.0 + tol):
+        failures.append(f"adaptive dispatches/batch {a_pb} regresses "
+                        f"static {s_pb} beyond {tol:.0%}")
+    s_tps = static["ordered_per_sim_second"] or 0.0
+    a_tps = adaptive["ordered_per_sim_second"] or 0.0
+    if a_tps < s_tps * (1.0 - tol):
+        failures.append(f"adaptive ordered/sim-sec {a_tps} regresses "
+                        f"static {s_tps} beyond {tol:.0%}")
+    record = {
+        "static_bursty": static,
+        "adaptive_bursty": adaptive,
+        "occupancy_floor": args.occupancy_floor,
+        "adaptive_tolerance": tol,
+        "adaptive_dispatch_ratio": round(a_pb / s_pb, 3) if s_pb else None,
+        "adaptive_sim_throughput_ratio": round(a_tps / s_tps, 3)
+        if s_tps else None,
+    }
+    return record, failures
 
 
 def main() -> int:
@@ -89,6 +174,15 @@ def main() -> int:
                     help="max device dispatches per ordered batch")
     ap.add_argument("--budget-per-message", type=float, default=0.25,
                     help="max device dispatches per delivered message")
+    ap.add_argument("--no-governor-gates", action="store_true",
+                    help="skip the bursty static-vs-adaptive comparison")
+    ap.add_argument("--occupancy-floor", type=float, default=0.01,
+                    help="min steady-state flush occupancy for the "
+                         "adaptive bursty run")
+    ap.add_argument("--adaptive-tolerance", type=float, default=0.05,
+                    help="max fractional regression the adaptive run may "
+                         "show vs the static run (dispatches/batch and "
+                         "ordered/sim-second)")
     ap.add_argument("--json", action="store_true",
                     help="emit the measurement as one JSON line")
     args = ap.parse_args()
@@ -105,6 +199,10 @@ def main() -> int:
     if per_msg > args.budget_per_message:
         over.append(f"dispatches/message {per_msg} "
                     f"> {args.budget_per_message}")
+    if not args.no_governor_gates:
+        record, failures = governor_gates(args)
+        result["governor_gate"] = record
+        over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:
         print(json.dumps(result, separators=(",", ":")))

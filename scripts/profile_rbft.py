@@ -35,11 +35,12 @@ from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
 BATCH = 160
 
 
-def _build_pool(n, k, tick_interval):
+def _build_pool(n, k, tick_interval, adaptive=False):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": tick_interval,
+        "QuorumTickAdaptive": adaptive,
     })
     return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
                    shadow_check=False, num_instances=k)
@@ -109,10 +110,14 @@ def main():
                          "hotspots + dispatch amortization metrics")
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the per-message baseline run in --json mode")
+    ap.add_argument("--static-tick", action="store_true",
+                    help="freeze the tick at 0.1 (skip the adaptive "
+                         "governor the profiled loop now runs by default)")
     args = ap.parse_args()
     n, k, txns = args.n_nodes, args.instances, args.txns
 
-    pool = _build_pool(n, k, tick_interval=0.1)
+    pool = _build_pool(n, k, tick_interval=0.1,
+                       adaptive=not args.static_tick)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
@@ -128,6 +133,10 @@ def main():
     batches = max(got / BATCH, 1e-9)
     per_batch = dispatches / batches
     occ = pool.metrics.stat(MetricsName.DEVICE_FLUSH_OCCUPANCY)
+    # adaptive-tick surface: where the governor left the interval and how
+    # long the run dwelt on each rung (static runs report the fixed tick
+    # and no histogram)
+    tick_stat = pool.metrics.stat(MetricsName.GOVERNOR_TICK_INTERVAL)
     record = {
         "n_nodes": n,
         "instances": k,
@@ -138,6 +147,12 @@ def main():
         "ordered_batches": round(batches, 2),
         "device_dispatches_per_ordered_batch": round(per_batch, 2),
         "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
+        "effective_tick_interval": (tick_stat.last if tick_stat
+                                    else pool.config.QuorumTickInterval),
+        "tick_interval_histogram": pool.metrics.histogram(
+            MetricsName.GOVERNOR_TICK_INTERVAL),
+        "governor": (pool.governor.trajectory_summary()
+                     if pool.governor is not None else None),
         "hotspots_top20_cumulative": _hotspots(prof),
     }
     if not args.no_baseline:

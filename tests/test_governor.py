@@ -1,0 +1,232 @@
+"""Dispatch governor: convergence, determinism, identity, observability.
+
+The adaptive tick's contract (README "Performance"): the interval is a
+pure function of the observed dispatch metrics — occupancy EWMA widens
+sparse pools toward QuorumTickIntervalMax, chained/hot ticks narrow
+toward QuorumTickIntervalMin — so a seeded run replays to the identical
+trajectory, and batching cadence NEVER changes ordering outcomes.
+"""
+import pytest
+
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.simulation.pool import SimPool
+from indy_plenum_tpu.tpu.governor import DispatchGovernor
+
+
+def make_governor(**kw):
+    defaults = dict(interval=0.05, min_interval=0.0125, max_interval=0.2,
+                    alpha=0.3, occupancy_low=0.02, occupancy_high=0.85,
+                    widen=1.5, narrow=0.5)
+    defaults.update(kw)
+    return DispatchGovernor(**defaults)
+
+
+def _adaptive_pool(seed=41, tick=0.05, overrides=None, **kwargs):
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+                        "QuorumTickInterval": tick,
+                        "QuorumTickAdaptive": tick > 0,
+                        **(overrides or {})})
+    return SimPool(4, seed=seed, config=config, device_quorum=True,
+                   shadow_check=False if tick > 0 else None, **kwargs)
+
+
+# ---------------------------------------------------------------------
+# control-law units
+# ---------------------------------------------------------------------
+
+def test_governor_bursty_idle_bursty_reaches_bounds():
+    """The convergence contract: saturation pins the interval to the
+    floor, a long idle stretch raises it to the ceiling, and a fresh
+    burst brings it back down — never leaving the configured bounds."""
+    g = make_governor()
+    for _ in range(10):  # bursty: chained grouped steps, full scatters
+        g.observe(votes=1536, capacity=1536, dispatches=3)
+    assert g.interval == g.min_interval
+    for _ in range(20):  # idle: occupancy EWMA decays below the floor
+        g.observe(votes=0, capacity=0, dispatches=0)
+    assert g.interval == g.max_interval
+    for _ in range(10):  # bursty again
+        g.observe(votes=1536, capacity=1536, dispatches=3)
+    assert g.interval == g.min_interval
+    assert min(g.trajectory) == g.min_interval
+    assert max(g.trajectory) == g.max_interval
+    assert g.ticks == 40 and len(g.trajectory) == 40
+
+
+def test_governor_holds_inside_the_band():
+    """One well-filled grouped step per tick is the plane's equilibrium:
+    the governor must not oscillate around it."""
+    g = make_governor()
+    for _ in range(50):
+        g.observe(votes=256, capacity=512, dispatches=1)  # occupancy 0.5
+    assert g.interval == 0.05
+    assert set(g.trajectory) == {0.05}
+
+
+def test_governor_determinism_same_observation_sequence():
+    seq = ([(0, 0, 0)] * 5 + [(512, 512, 2)] * 7 + [(3, 64, 1)] * 9
+           + [(0, 0, 0)] * 4)
+    a, b = make_governor(), make_governor()
+    for votes, cap, disp in seq:
+        a.observe(votes, cap, disp)
+        b.observe(votes, cap, disp)
+    assert a.trajectory == b.trajectory
+    assert a.ewma == b.ewma
+    assert a.trajectory_summary() == b.trajectory_summary()
+
+
+def test_governor_parameter_validation():
+    with pytest.raises(ValueError):
+        DispatchGovernor(0.05, 0.0, 0.2)  # zero floor
+    with pytest.raises(ValueError):
+        DispatchGovernor(0.05, 0.2, 0.1)  # inverted bounds
+    with pytest.raises(ValueError):
+        make_governor(widen=0.9)  # widen must widen
+    with pytest.raises(ValueError):
+        make_governor(narrow=1.5)  # narrow must narrow
+    # start interval is clamped into the bounds
+    assert DispatchGovernor(5.0, 0.01, 0.2).interval == 0.2
+
+
+def test_from_config_gating_and_default_bounds():
+    assert DispatchGovernor.from_config(
+        getConfig({"QuorumTickInterval": 0.05})) is None  # not adaptive
+    assert DispatchGovernor.from_config(
+        getConfig({"QuorumTickAdaptive": True})) is None  # not tick mode
+    g = DispatchGovernor.from_config(getConfig(
+        {"QuorumTickInterval": 0.05, "QuorumTickAdaptive": True}))
+    assert g is not None
+    assert (g.min_interval, g.max_interval) == (0.0125, 0.2)
+    g = DispatchGovernor.from_config(getConfig(
+        {"QuorumTickInterval": 0.05, "QuorumTickAdaptive": True,
+         "QuorumTickIntervalMin": 0.02, "QuorumTickIntervalMax": 0.08}))
+    assert (g.min_interval, g.max_interval) == (0.02, 0.08)
+
+
+# ---------------------------------------------------------------------
+# closed loop over a real pool
+# ---------------------------------------------------------------------
+
+def test_pool_trajectory_deterministic_and_widens_when_idle():
+    """Same seed, same workload ⇒ bit-identical interval trajectory; the
+    idle stretch after ordering completes must widen the tick to its
+    configured ceiling (the convergence bound, measured in-pool)."""
+
+    def run():
+        pool = _adaptive_pool(seed=53)
+        for i in range(6):
+            pool.submit_request(i)
+        pool.run_for(10)
+        assert pool.honest_nodes_agree()
+        assert all(len(n.ordered_digests) == 6 for n in pool.nodes)
+        return (list(pool.governor.trajectory),
+                [tuple(n.ordered_digests) for n in pool.nodes])
+
+    traj1, digests1 = run()
+    traj2, digests2 = run()
+    assert traj1 == traj2
+    assert digests1 == digests2
+    assert traj1, "governor never observed a tick"
+    assert max(traj1) == pool_max_bound()  # idle widened to the ceiling
+
+
+def pool_max_bound() -> float:
+    lo, hi = getConfig({"QuorumTickInterval": 0.05,
+                        "QuorumTickAdaptive": True}).governor_bounds()
+    return hi
+
+
+def test_pool_narrows_under_saturation():
+    """With the hot-occupancy threshold lowered into this small pool's
+    range, a burst must drive the interval BELOW the base tick (the
+    narrow half of the control law, exercised through the real loop)."""
+    pool = _adaptive_pool(seed=59, overrides={
+        "GovernorOccupancyHigh": 0.05, "GovernorOccupancyLow": 0.001})
+    base = pool.config.QuorumTickInterval
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert all(len(n.ordered_digests) == 12 for n in pool.nodes)
+    assert min(pool.governor.trajectory) < base
+    assert min(pool.governor.trajectory) >= pool.governor.min_interval
+
+
+def test_adaptive_tick_matches_per_message_digests():
+    """The governor changes COST, never OUTCOMES: adaptive-tick and
+    per-message runs on the same seed order identical digests, including
+    through a view change in the middle."""
+
+    def run(tick):
+        pool = _adaptive_pool(seed=47, tick=tick)
+        primary = pool.nodes[0].data.primaries[0]
+        for i in range(4):
+            pool.submit_request(i)
+        pool.run_for(8)
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 104):
+            pool.submit_request(i)
+        pool.run_for(12)
+        return {n.name: tuple(n.ordered_digests) for n in pool.nodes
+                if n.name != primary}
+
+    assert run(0.05) == run(0.0)
+
+
+def test_monitor_snapshot_surfaces_tick_interval():
+    """Monitor.snapshot()'s device_dispatch block carries the CURRENT
+    effective interval and the dwell histogram (NodePool shares one
+    collector, so every node's monitor sees the pool governor)."""
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                        "PropagateBatchWait": 0.05,
+                        "QuorumTickInterval": 0.05,
+                        "QuorumTickAdaptive": True})
+    pool = NodePool(4, seed=81, config=config, device_quorum=True)
+    for _ in range(3):
+        pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(15)
+    assert all(len(n.ordered_digests) == 3 for n in pool.nodes)
+    assert pool.governor is not None and pool.governor.ticks > 0
+
+    snap = pool.node("node0").monitor.snapshot()
+    device = snap["device_dispatch"]
+    tick = device["tick_interval"]
+    lo, hi = pool.config.governor_bounds()
+    assert lo <= tick["current"] <= hi
+    assert lo <= tick["min"] <= tick["max"] <= hi
+    assert tick["histogram"] and sum(
+        tick["histogram"].values()) == pool.governor.ticks
+    assert "occupancy_ewma" in device
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_adaptive_tick_deterministic_and_orders_like_per_message():
+    """Chaos-grade determinism (the replay contract): the same seeded
+    f_crash_partition run through the ADAPTIVE dispatch plane twice
+    yields the identical interval trajectory and identical per-node
+    ordered-digest hashes — and the same ordering as the per-message
+    loop on that seed."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    def adaptive():
+        return run_scenario("f_crash_partition", seed=7,
+                            device_quorum=True,
+                            quorum_tick_interval=0.05,
+                            quorum_tick_adaptive=True)
+
+    r1, r2 = adaptive(), adaptive()
+    assert r1.verdict_as_expected, r1.failed
+    assert not r1.expected_failures
+    # the governor actually ran, and deterministically
+    assert r1.metrics["governor.tick_interval"]["count"] > 0
+    assert (r1.metrics["governor.tick_interval"]
+            == r2.metrics["governor.tick_interval"])
+    assert (r1.metrics["governor.occupancy_ewma"]
+            == r2.metrics["governor.occupancy_ewma"])
+    assert r1.ordered_hash_per_node == r2.ordered_hash_per_node
+
+    base = run_scenario("f_crash_partition", seed=7, device_quorum=True)
+    assert r1.ordered_hash_per_node == base.ordered_hash_per_node

@@ -106,3 +106,37 @@ def test_recorded_run_replays_to_identical_ordered_log(tmp_path):
                 == original.boot.db.get_ledger(lid).root_hash)
         assert (fresh.boot.db.get_state(lid).committed_head_hash
                 == original.boot.db.get_state(lid).committed_head_hash)
+
+
+def test_metrics_last_and_bounded_histogram():
+    """Stat.last tracks the CURRENT value of control variables (the
+    governor's effective tick interval) and histograms stay bounded."""
+    from indy_plenum_tpu.common.metrics_collector import (
+        HISTOGRAM_MAX_BUCKETS,
+        HISTOGRAM_OVERFLOW_KEY,
+        NullMetricsCollector,
+    )
+
+    m = MetricsCollector()
+    m.add_event("x", 2.0)
+    m.add_event("x", 5.0)
+    assert m.stat("x").last == 5.0
+    assert m.summary()["x"]["last"] == 5.0
+
+    for v in (0.05, 0.05, 0.1):
+        m.add_to_histogram("h", v)
+    assert m.histogram("h") == {0.05: 2, 0.1: 1}
+    assert m.histogram("missing") is None
+    # returned histogram is a copy, not the live dict
+    m.histogram("h")["h4x"] = 99
+    assert "h4x" not in m.histogram("h")
+
+    for i in range(HISTOGRAM_MAX_BUCKETS + 100):
+        m.add_to_histogram("b", i)
+    hist = m.histogram("b")
+    assert len(hist) == HISTOGRAM_MAX_BUCKETS + 1
+    assert hist[HISTOGRAM_OVERFLOW_KEY] == 100
+
+    null = NullMetricsCollector()
+    null.add_to_histogram("h", 1)
+    assert null.histogram("h") is None

@@ -275,3 +275,118 @@ def test_hwm_drop_is_counted():
     stacks["B"]._remotes["A"] = real_sock
     for s in stacks.values():
         s.close()
+
+
+def test_looper_drains_transports_before_timer_events():
+    """The zstack transport barrier: within one pump pass, prodables
+    (socket drains) run BEFORE due timer events, so a barrier quorum
+    tick always evaluates a drained transport."""
+    from indy_plenum_tpu.common.looper import Looper
+
+    looper = Looper()
+    order = []
+
+    class FakeStack:
+        def service(self):
+            order.append("drain")
+            return 0
+
+    looper.add(FakeStack())
+    looper.timer.schedule(0.0, lambda: order.append("tick"))
+    looper._pump_once()
+    assert order == ["drain", "tick"]
+
+
+@pytest.mark.slow
+def test_zstack_barrier_tick_with_governor_over_sockets():
+    """Deployed-node dispatch plane: 4 full Nodes over REAL sockets, each
+    flushing its own device vote plane on a governed barrier tick. The
+    pool orders identically on every node, the tick amortizes (far fewer
+    device dispatches than transport messages), and the governor runs —
+    the live-transport analog of the sim pools' tick contract."""
+    from indy_plenum_tpu.common.constants import TRUSTEE
+    from indy_plenum_tpu.common.metrics_collector import (
+        MetricsCollector,
+        MetricsName,
+    )
+    from indy_plenum_tpu.common.request import Request
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.crypto.signers import DidSigner
+    from indy_plenum_tpu.ledger.genesis import genesis_nym_txn
+    from indy_plenum_tpu.tpu.vote_plane import DeviceVotePlane
+
+    names = [f"node{i}" for i in range(4)]
+    config = getConfig({"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
+                        "PropagateBatchWait": 0.02,
+                        "QuorumTickInterval": 0.05,
+                        "QuorumTickAdaptive": True})
+    trustee = DidSigner(b"\x09" * 32)
+    genesis = [genesis_nym_txn(trustee.identifier, trustee.verkey,
+                               role=TRUSTEE)]
+
+    looper = Looper()
+    stacks = wire(names)
+    nodes = []
+    for name in names:
+        net = ZStackNetwork(stacks[name])
+        plane = DeviceVotePlane(
+            names, log_size=config.LOG_SIZE,
+            n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ))
+        node = Node(name, names, looper.timer, net, config=config,
+                    domain_genesis=[dict(t) for t in genesis],
+                    seed_keys={trustee.identifier: trustee.verkey},
+                    vote_plane=plane, metrics=MetricsCollector())
+        net.mark_connected(set(names) - {name})
+        node.start()
+        looper.add(stacks[name])
+        nodes.append(node)
+
+    reqs = []
+    for i in range(6):
+        from indy_plenum_tpu.common.constants import (
+            NYM, TARGET_NYM, TXN_TYPE, VERKEY)
+
+        target = DidSigner(hashlib.sha256(b"gov-target-%d" % i).digest())
+        req = Request(identifier=trustee.identifier, reqId=i + 1,
+                      operation={TXN_TYPE: NYM,
+                                 TARGET_NYM: target.identifier,
+                                 VERKEY: target.verkey})
+        trustee.sign_request(req)
+        reqs.append(req)
+
+    # compile device kernels outside the liveness budget
+    assert nodes[0].authnr.authenticate_batch([reqs[0]]).all()
+    nodes[0].vote_plane.sync()
+
+    for i, req in enumerate(reqs):
+        nodes[i % 4].submit_client_request(req, client_id="cli")
+
+    ok = looper.run_until(
+        lambda: all(len(n.ordered_digests) == 6 for n in nodes),
+        timeout=60)
+    assert ok, [len(n.ordered_digests) for n in nodes]
+    assert len({tuple(n.ordered_digests) for n in nodes}) == 1
+
+    for node in nodes:
+        # the barrier tick actually drove the plane (and the governor)
+        per_tick = node.metrics.stat(MetricsName.DEVICE_DISPATCHES_PER_TICK)
+        assert per_tick is not None and per_tick.count > 0
+        assert node._dispatch_governor is not None
+        assert node._dispatch_governor.ticks > 0
+        lo, hi = config.governor_bounds()
+        assert lo <= node._dispatch_governor.interval <= hi
+        assert node.metrics.histogram(MetricsName.GOVERNOR_TICK_INTERVAL)
+        # amortization over the live transport: one tick's grouped step
+        # covers many socket deliveries (transport Batch envelopes mean
+        # `received` already undercounts protocol messages, so flushes
+        # beating even that is a conservative bar)
+        received = stacks[node.name].received
+        assert received > 15
+        assert node.vote_plane.flushes < 0.5 * received, (
+            node.vote_plane.flushes, received)
+
+    looper.shutdown()
+    for node in nodes:
+        node.stop()
+    for s in stacks.values():
+        s.close()

@@ -36,6 +36,12 @@ class ChaosReport:
     periodic_checks: int = 0
     first_violation: Optional[Tuple[float, str]] = None
     virtual_seconds: float = 0.0
+    # how the run was routed through the dispatch plane (device quorum /
+    # tick / adaptive / mesh width): replay_command must reproduce the
+    # exact pipeline, not just the fault schedule — a mesh run replayed
+    # unsharded would still order identically (that's the tested
+    # contract) but would no longer exercise the path being debugged
+    dispatch_mode: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> List[str]:
@@ -50,8 +56,18 @@ class ChaosReport:
 
     @property
     def replay_command(self) -> str:
-        return (f"python scripts/chaos_run.py --seed {self.seed} "
-                f"--scenario {self.scenario} --nodes {self.n_nodes}")
+        cmd = (f"python scripts/chaos_run.py --seed {self.seed} "
+               f"--scenario {self.scenario} --nodes {self.n_nodes}")
+        mode = self.dispatch_mode
+        if mode.get("device_quorum"):
+            cmd += " --device-quorum"
+        if mode.get("tick"):
+            cmd += f" --tick {mode['tick']}"
+        if mode.get("adaptive"):
+            cmd += " --adaptive-tick"
+        if mode.get("mesh"):
+            cmd += f" --mesh {mode['mesh']}"
+        return cmd
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -59,6 +75,7 @@ class ChaosReport:
             "seed": self.seed,
             "n_nodes": self.n_nodes,
             "replay_command": self.replay_command,
+            "dispatch_mode": dict(self.dispatch_mode),
             "verdict_as_expected": self.verdict_as_expected,
             "invariants": self.invariants,
             "expected_failures": list(self.expected_failures),

@@ -45,7 +45,8 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                  probe_interval: float = 1.0,
                  device_quorum: bool = False,
                  quorum_tick_interval: float = 0.0,
-                 quorum_tick_adaptive: bool = False) -> ChaosReport:
+                 quorum_tick_adaptive: bool = False,
+                 mesh=None) -> ChaosReport:
     """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
     through the tick-batched dispatch plane (grouped device flushes, per-
     tick quorum evaluation) — fault paths must survive the tick barrier
@@ -54,7 +55,13 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     ``quorum_tick_adaptive`` additionally hands the tick to the dispatch
     governor: the report's ``governor.tick_interval`` metrics then record
     the interval trajectory (deterministic — replaying the same seed
-    yields the identical trajectory, which tests assert)."""
+    yields the identical trajectory, which tests assert).
+    ``mesh`` shards the grouped vote plane's member axis across a jax
+    device mesh — fault paths must survive the mesh-sharded dispatch
+    plane bit-for-bit (``ordered_hash_per_node`` equal to the 1-device
+    run on the same seed), which the slow-lane mesh chaos test asserts."""
+    if mesh is not None and not device_quorum:
+        raise ValueError("mesh requires device_quorum")
     if quorum_tick_interval > 0 and not device_quorum:
         # the services gate tick mode on having a vote plane: without
         # device_quorum the override would silently run the plain
@@ -73,7 +80,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
     config = getConfig(overrides)
     pool = SimPool(n_nodes=n, seed=seed, config=config,
-                   device_quorum=device_quorum)
+                   device_quorum=device_quorum, mesh=mesh)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,
@@ -105,6 +112,12 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         scenario=scenario.name,
         seed=seed,
         n_nodes=n,
+        dispatch_mode={
+            "device_quorum": device_quorum,
+            "tick": quorum_tick_interval,
+            "adaptive": quorum_tick_adaptive,
+            "mesh": int(mesh.devices.size) if mesh is not None else 0,
+        },
         plan=plan.as_dicts(),
         trace=list(scheduler.trace),
         invariants=[r.as_dict() for r in results],

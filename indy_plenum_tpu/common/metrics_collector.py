@@ -36,6 +36,17 @@ class MetricsName:
     # the votes-per-tick / padded-shape ratio (see README "Performance").
     DEVICE_DISPATCHES_PER_TICK = "device.dispatches_per_tick"
     DEVICE_FLUSH_OCCUPANCY = "device.flush_occupancy"
+    # mesh-sharded dispatch plane: shard count (Stat.last = the current
+    # mesh width) and per-shard vote/capacity counters, recorded as
+    # "<prefix>.<shard_index>". Votes and capacity are separate series
+    # (capacity counts REAL, non-pad rows only) so every consumer
+    # derives the SAME cumulative occupancy — sum(votes)/sum(capacity),
+    # the VotePlaneGroup.shard_occupancy definition — instead of an
+    # average of per-dispatch ratios that diverges once flush shapes
+    # vary. Only recorded when the group runs on a mesh (> 1 shard).
+    DEVICE_SHARD_COUNT = "device.shard_count"
+    DEVICE_SHARD_FLUSH_VOTES = "device.shard_flush_votes"
+    DEVICE_SHARD_FLUSH_CAPACITY = "device.shard_flush_capacity"
     # dispatch governor (adaptive tick, tpu/governor.py): the effective
     # interval after every tick (Stat.last = the CURRENT interval; the
     # histogram records how long the pool dwelt on each rung) and the
@@ -43,6 +54,9 @@ class MetricsName:
     # adaptive run's trajectory a comparable, replayable artifact
     GOVERNOR_TICK_INTERVAL = "governor.tick_interval"
     GOVERNOR_OCCUPANCY_EWMA = "governor.occupancy_ewma"
+    # per-shard EWMAs under a mesh ("<prefix>.<shard_index>"): the
+    # series the hottest-shard law acts on
+    GOVERNOR_SHARD_OCCUPANCY_EWMA = "governor.shard_occupancy_ewma"
     # execution
     COMMIT_TIME = "exec.commit_time"
     # catchup

@@ -107,6 +107,15 @@ class Config:
     GovernorOccupancyHigh: float = 0.85  # EWMA above this narrows it
     GovernorWiden: float = 1.5  # multiplicative widen step
     GovernorNarrow: float = 0.5  # multiplicative narrow step
+    # Adaptive flush ladder (vote_plane.AdaptiveLadder): the grouped
+    # dispatch plane learns its top padded-scatter rung from the
+    # observed busiest-member votes-per-dispatch distribution (p99
+    # rounded up to a power of two, clamped to the static FLUSH_LADDER
+    # bounds), so a small pool stops compiling and paying the 128-wide
+    # rung. Deterministic (pure function of the dispatch series);
+    # learning only starts after a warm-up window, so short runs keep
+    # the static ladder's exact behaviour.
+    FlushLadderAdaptive: bool = True
 
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory

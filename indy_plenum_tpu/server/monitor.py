@@ -163,6 +163,25 @@ class Monitor:
                     MetricsName.GOVERNOR_OCCUPANCY_EWMA)
                 if ewma is not None:
                     device["occupancy_ewma"] = round(ewma.last, 4)
+            # mesh-sharded dispatch plane: mesh width + each shard's
+            # CUMULATIVE occupancy (sum votes / sum real-row capacity —
+            # the same VotePlaneGroup.shard_occupancy number bench, the
+            # budget gate and profile_rbft report, NOT an average of
+            # per-dispatch ratios, which diverges once flush shapes vary)
+            shard_count = self._metrics.stat(MetricsName.DEVICE_SHARD_COUNT)
+            if shard_count is not None and shard_count.last:
+                n_shards = int(shard_count.last)
+                occ_per_shard = []
+                for si in range(n_shards):
+                    votes = self._metrics.stat(
+                        f"{MetricsName.DEVICE_SHARD_FLUSH_VOTES}.{si}")
+                    cap = self._metrics.stat(
+                        f"{MetricsName.DEVICE_SHARD_FLUSH_CAPACITY}.{si}")
+                    occ_per_shard.append(
+                        round(votes.total / cap.total, 4)
+                        if votes and cap and cap.total else None)
+                device["shards"] = n_shards
+                device["shard_occupancy"] = occ_per_shard
             if device:
                 snap["device_dispatch"] = device
         return snap

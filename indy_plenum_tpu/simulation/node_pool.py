@@ -30,8 +30,12 @@ class NodePool:
                  device_quorum: bool = False,
                  bls: bool = False,
                  num_instances: int = 1,
-                 with_pool_genesis: bool = False):
+                 with_pool_genesis: bool = False,
+                 mesh=None):
         # num_instances: 1 = master only; 0 = auto f+1 (full RBFT)
+        # mesh: shard the grouped vote plane's (node x instance) member
+        # axis across a jax device mesh (CPU CI provisions virtual
+        # devices via XLA_FLAGS=--xla_force_host_platform_device_count)
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
              "PropagateBatchWait": 0.05})
@@ -89,7 +93,8 @@ class NodePool:
         if device_quorum:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
-                num_instances=resolved_instances, metrics=self.metrics)
+                num_instances=resolved_instances, mesh=mesh,
+                metrics=self.metrics)
 
         tick_mode = self.config.QuorumTickInterval > 0
 

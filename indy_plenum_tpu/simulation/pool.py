@@ -605,3 +605,11 @@ class SimPool:
         lengths = {len(l) for l in logs}
         shortest = min(lengths)
         return all(l[:shortest] == logs[0][:shortest] for l in logs)
+
+    def ordered_hash(self) -> str:
+        """sha256 of node0's ordered-digest sequence — THE pool-ordering
+        fingerprint (callers assert honest_nodes_agree first, so one
+        node identifies the pool). bench.py's sharded sub-bench and
+        check_dispatch_budget's sharded gate compare runs on it."""
+        return hashlib.sha256(
+            "|".join(self.nodes[0].ordered_digests).encode()).hexdigest()

@@ -15,6 +15,11 @@ against the fresh snapshot. ``device.dispatches_per_tick`` and
 ``device.flush_occupancy`` land in the group's metrics collector so the
 amortization is a regression-guarded number
 (``scripts/check_dispatch_budget.py``).
+
+With a ``mesh`` the same contract runs SPMD: the member axis is sharded
+over the devices (``shard_map``), each shard stages its own scatter rows,
+and the governor observes PER-SHARD occupancy — one hot shard narrows the
+tick for everyone (README "Mesh-sharded dispatch plane").
 """
 from __future__ import annotations
 
@@ -32,16 +37,20 @@ def make_vote_group(n_nodes: int, validators, config: Config,
     is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
     mapping — instances are a leading tensor dimension, so backups' vote
     tallies ride the same vmapped dispatch as the master's). ``mesh``
-    shards that member axis across a device mesh (SPMD group step);
-    ``pipelined`` overlaps each tick's device round-trip with the next
-    tick's host work (verdicts lag one tick)."""
+    shards that member axis across a device mesh via ``shard_map`` (the
+    member count is padded up to a mesh multiple; quorum events gather
+    back in one readback); ``pipelined`` overlaps each tick's device
+    round-trip with the next tick's host work (verdicts lag one tick).
+    ``config.FlushLadderAdaptive`` hands the padded flush width to the
+    learned per-pool ladder."""
     from ..tpu.vote_plane import VotePlaneGroup
 
     return VotePlaneGroup(
         n_nodes * max(1, num_instances), list(validators),
         log_size=config.LOG_SIZE,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
-        mesh=mesh, pipelined=pipelined, metrics=metrics)
+        mesh=mesh, pipelined=pipelined, metrics=metrics,
+        adaptive_ladder=config.FlushLadderAdaptive)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
@@ -83,6 +92,11 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                                             metrics=vote_group.metrics)
     last = [vote_group.flushes, vote_group.flush_votes_total,
             vote_group.flush_capacity_total]
+    # per-shard baselines (length 1 when unsharded): the governor's law
+    # runs on per-shard occupancy deltas, so a mesh run's hot shard
+    # narrows the tick for the whole pool
+    last_shard = [list(vote_group.flush_votes_per_shard),
+                  list(vote_group.flush_capacity_per_shard)]
     timer_box: list = []  # the RepeatingTimer, bound after construction
 
     def tick() -> None:
@@ -97,12 +111,17 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
         vote_group.metrics.add_event(
             MetricsName.DEVICE_DISPATCHES_PER_TICK, dispatches)
         if governor is not None:
-            new_interval = governor.observe(
-                vote_group.flush_votes_total - last[1],
-                vote_group.flush_capacity_total - last[2], dispatches)
+            new_interval = governor.observe_shards(
+                [a - b for a, b in zip(vote_group.flush_votes_per_shard,
+                                       last_shard[0])],
+                [a - b for a, b in zip(vote_group.flush_capacity_per_shard,
+                                       last_shard[1])],
+                dispatches)
             timer_box[0].update_interval(new_interval)
         last[:] = [vote_group.flushes, vote_group.flush_votes_total,
                    vote_group.flush_capacity_total]
+        last_shard[0] = list(vote_group.flush_votes_per_shard)
+        last_shard[1] = list(vote_group.flush_capacity_per_shard)
         flush_dt = perf_counter() - t0 if accounting is not None else 0.0
         for node in nodes:
             t0 = perf_counter() if accounting is not None else 0.0

@@ -73,6 +73,11 @@ class DispatchGovernor:
         self.widen = float(widen)
         self.narrow = float(narrow)
         self.ewma: Optional[float] = None  # occupancy EWMA (None = cold)
+        # per-shard EWMAs (mesh-sharded dispatch plane): one series per
+        # shard, all fed the same law; ``ewma`` above is always the
+        # HOTTEST shard's value (with one shard they coincide, which is
+        # exactly the PR 3 behaviour)
+        self.shard_ewmas: Optional[list] = None
         self.ticks = 0
         # interval AFTER each observation (bounded recent window); the
         # running extremes below stay exact over the whole run
@@ -89,12 +94,28 @@ class DispatchGovernor:
         and padded scatter capacity (0/0 for an idle tick — occupancy 0,
         which is what lets an idle pool widen); ``dispatches`` is how many
         grouped device steps the tick chained."""
-        occupancy = votes / capacity if capacity > 0 else 0.0
-        if self.ewma is None:
-            self.ewma = occupancy
+        return self.observe_shards([votes], [capacity], dispatches)
+
+    def observe_shards(self, votes_per_shard, capacity_per_shard,
+                       dispatches: int) -> float:
+        """Per-shard variant of :meth:`observe` for the mesh-sharded
+        dispatch plane: each shard's occupancy feeds its OWN EWMA, and
+        the control law acts on the hottest one — a saturated shard
+        narrows the tick for the whole pool even while its siblings
+        idle, deterministically (a pool-wide average would let n-1 idle
+        shards mask one drowning in votes). With a single shard this is
+        bit-for-bit the PR 3 law."""
+        occs = [v / c if c > 0 else 0.0
+                for v, c in zip(votes_per_shard, capacity_per_shard)]
+        if not occs:
+            occs = [0.0]
+        if self.shard_ewmas is None or len(self.shard_ewmas) != len(occs):
+            self.shard_ewmas = list(occs)  # cold (or shard-count change)
         else:
-            self.ewma = (self.alpha * occupancy
-                         + (1.0 - self.alpha) * self.ewma)
+            self.shard_ewmas = [
+                self.alpha * occ + (1.0 - self.alpha) * ewma
+                for occ, ewma in zip(occs, self.shard_ewmas)]
+        self.ewma = max(self.shard_ewmas)
         if dispatches > 1 or self.ewma >= self.occupancy_high:
             self.interval = max(self.interval * self.narrow,
                                 self.min_interval)
@@ -113,6 +134,11 @@ class DispatchGovernor:
                                       round(self.interval, 6))
         self.metrics.add_event(MetricsName.GOVERNOR_OCCUPANCY_EWMA,
                                self.ewma)
+        if len(self.shard_ewmas) > 1:
+            for si, ewma in enumerate(self.shard_ewmas):
+                self.metrics.add_event(
+                    f"{MetricsName.GOVERNOR_SHARD_OCCUPANCY_EWMA}.{si}",
+                    ewma)
         return self.interval
 
     # ------------------------------------------------------------------
@@ -130,7 +156,7 @@ class DispatchGovernor:
         mid = len(ordered) // 2
         median = ordered[mid] if len(ordered) % 2 else (
             ordered[mid - 1] + ordered[mid]) / 2.0
-        return {
+        out = {
             "ticks": self.ticks,
             "interval_min": round(self._interval_low, 6),
             "interval_median": round(median, 6),
@@ -138,6 +164,11 @@ class DispatchGovernor:
             "occupancy_ewma": (round(self.ewma, 6)
                                if self.ewma is not None else None),
         }
+        if self.shard_ewmas is not None and len(self.shard_ewmas) > 1:
+            out["shards"] = len(self.shard_ewmas)
+            out["shard_occupancy_ewma"] = [
+                round(e, 6) for e in self.shard_ewmas]
+        return out
 
     @classmethod
     def from_config(cls, config, metrics: Optional[MetricsCollector] = None

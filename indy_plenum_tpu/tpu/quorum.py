@@ -220,6 +220,38 @@ def make_sharded_step(mesh: Mesh, n_validators: int, axis: str = "validators"):
     return jax.jit(shard_fn)
 
 
+def member_sharded_specs(axis: str):
+    """PartitionSpecs for a GROUP step whose LEADING axis is the member
+    axis M (= nodes x instances), sharded over mesh axis ``axis``.
+
+    Every VoteState/QuorumEvents leaf gains a leading member dim and
+    nothing below it is sharded — members are independent planes, so the
+    grouped step needs no cross-member collectives and each chip keeps
+    its member shard entirely local. Returns
+    ``(state_spec, row_spec, events_spec, vec_spec)`` where ``row_spec``
+    covers (M, B) operands (the packed scatter words) and ``vec_spec``
+    covers (M,) operands (slide deltas, reset masks)."""
+    vec = P(axis)
+    row = P(axis, None)
+    mat = P(axis, None, None)
+    state_spec = VoteState(
+        preprepare_seen=row,
+        prepare_votes=mat,
+        commit_votes=mat,
+        checkpoint_votes=mat,
+        ordered=row,
+    )
+    events_spec = QuorumEvents(
+        prepared=row,
+        newly_ordered=row,
+        ordered=row,
+        stable_checkpoints=row,
+        prepare_counts=row,
+        commit_counts=row,
+    )
+    return state_spec, row, events_spec, vec
+
+
 def unpack_words(words: jnp.ndarray) -> MsgBatch:
     """Device-side decode of word-packed votes (see ``pack_words``).
 

@@ -28,9 +28,18 @@ ingress path likewise verifies whole request batches per tick.
 
 Padded flush shapes come from a small ladder (``FLUSH_LADDER``): each
 rung compiles exactly once, and a near-empty tick rides the smallest rung
-instead of paying the full-width scatter for a handful of votes.
-``flush_occupancy`` (votes / padded capacity) is recorded per dispatch so
-the amortization is a measured number, not a docstring claim.
+instead of paying the full-width scatter for a handful of votes. With
+:class:`AdaptiveLadder` the top rung is LEARNED from the observed
+votes-per-dispatch distribution, so small pools stop compiling the
+full-width shape. ``flush_occupancy`` (votes / padded capacity) is
+recorded per dispatch so the amortization is a measured number, not a
+docstring claim.
+
+Scale past one chip: :class:`VotePlaneGroup` accepts a ``mesh`` and runs
+the grouped step explicit-SPMD over the member axis via ``shard_map``
+(pad M → shard → per-shard stage → single grouped step → gathered
+events — README "Mesh-sharded dispatch plane"), with per-shard occupancy
+series feeding the dispatch governor's hottest-shard law.
 """
 from __future__ import annotations
 
@@ -60,6 +69,68 @@ def ladder_shape(n_votes: int) -> int:
         if n_votes <= rung:
             return rung
     return FLUSH_BATCH
+
+
+def pow2_rung(n_votes: int) -> int:
+    """Smallest power-of-two rung >= ``n_votes``, clamped to the static
+    ladder's bounds [FLUSH_LADDER[0], FLUSH_BATCH]."""
+    rung = FLUSH_LADDER[0]
+    while rung < min(n_votes, FLUSH_BATCH):
+        rung *= 2
+    return rung
+
+
+class AdaptiveLadder:
+    """Learned per-pool top flush rung (ROADMAP PR 3 "let the ladder
+    itself adapt").
+
+    The static ladder (16/128) makes a small pool whose busiest member
+    buffers ~20 votes per dispatch pay a 128-wide scatter (and its XLA
+    compile) forever. This controller watches the observed busiest-
+    member votes-per-dispatch distribution and sets the pool's top rung
+    to the p99 rounded UP to a power of two, clamped to the static
+    ladder's bounds — so that pool settles on a 32-wide scatter and the
+    128-wide shape is never compiled. Overflow dispatches beyond the
+    learned top still get a containing power-of-two shape (each is one
+    cached compilation, exactly like the static rungs).
+
+    Deterministic: ``top`` is a pure function of the recorded sample
+    series (integer percentile math, bounded window), so seeded runs
+    replay the identical shape sequence. Learning starts only after
+    ``min_samples`` dispatches — short runs (and most unit tests) keep
+    the static ladder's exact behaviour.
+    """
+
+    def __init__(self, window: int = 512, min_samples: int = 64,
+                 recompute_every: int = 32):
+        from collections import deque
+
+        self._samples: "deque[int]" = deque(maxlen=window)
+        self._min_samples = min_samples
+        # the p99 recompute sorts the whole window — done on a stride,
+        # not per dispatch, so the hot flush loop (which PR 2/3 already
+        # de-allocated) doesn't buy back an O(W log W) sort per flush
+        self._recompute_every = recompute_every
+        self._count = 0
+        self.top = FLUSH_BATCH
+
+    def record(self, busiest_votes: int) -> None:
+        self._samples.append(busiest_votes)
+        self._count += 1
+        if (self._count >= self._min_samples
+                and (self._count - self._min_samples)
+                % self._recompute_every == 0):
+            ordered = sorted(self._samples)
+            # ceil(p99) index in pure integer math (determinism)
+            idx = (99 * (len(ordered) - 1) + 99) // 100
+            self.top = pow2_rung(ordered[idx])
+
+    def shape(self, n_votes: int) -> int:
+        if n_votes <= FLUSH_LADDER[0]:
+            return FLUSH_LADDER[0]
+        if n_votes <= self.top:
+            return self.top
+        return pow2_rung(n_votes)
 
 
 # double-buffered device steps: donate the state operand so XLA writes
@@ -159,6 +230,56 @@ def _group_slide(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
 @jax.jit
 def _group_zero_member(states: q.VoteState, member: jnp.ndarray) -> q.VoteState:
     return jax.tree.map(lambda x: x.at[member].set(0), states)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_group_fns(mesh, axis: str, n_validators: int):
+    """shard_map'd (step, slide, zero) for a member-sharded group.
+
+    The member axis M is split across ``mesh``; inside each shard the
+    PLAIN per-member step/slide runs vmapped over the local rows —
+    members are independent planes, so no cross-member collectives exist
+    and XLA keeps every shard's tensors on its own chip. This is the
+    explicit-SPMD successor of the PR 2 auto-partitioned mesh path: the
+    sharding of every operand and result is stated, not inferred, so the
+    grouped dispatch can never silently fall back to an all-gather.
+
+    The step is jitted with the state operand donated (same PR 3
+    double-buffer contract as the unsharded `_group_step_words`, gated
+    off XLA:CPU) and ``zero`` takes an (M,) member MASK instead of a
+    scalar index — a dynamic row index cannot be resolved against a
+    shard-local block, a mask shards trivially.
+    """
+    state_spec, row_spec, events_spec, vec_spec = q.member_sharded_specs(axis)
+
+    def step_impl(states, words):
+        msgs = q.unpack_words(words)
+        return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+
+    step = functools.partial(jax.jit, donate_argnums=_state_donation())(
+        q.shard_map_compat(step_impl, mesh=mesh,
+                           in_specs=(state_spec, row_spec),
+                           out_specs=(state_spec, events_spec)))
+
+    def slide_impl(states, deltas):
+        return jax.vmap(_slide_core)(states, deltas)
+
+    slide = jax.jit(q.shard_map_compat(
+        slide_impl, mesh=mesh, in_specs=(state_spec, vec_spec),
+        out_specs=state_spec))
+
+    def zero_impl(states, mask):
+        def z(x):
+            hit = mask.reshape((-1,) + (1,) * (x.ndim - 1)) != 0
+            return jnp.where(hit, jnp.zeros((), x.dtype), x)
+
+        return jax.tree.map(z, states)
+
+    zero = jax.jit(q.shard_map_compat(
+        zero_impl, mesh=mesh, in_specs=(state_spec, vec_spec),
+        out_specs=state_spec))
+
+    return step, slide, zero
 
 
 class DeviceVotePlane:
@@ -365,31 +486,58 @@ class VotePlaneGroup:
 
     def __init__(self, n_members: int, validators: List[str], log_size: int,
                  n_checkpoints: int = 4, h: int = 0, metrics=None,
-                 mesh=None, pipelined: bool = False):
+                 mesh=None, pipelined: bool = False,
+                 adaptive_ladder: bool = False):
         """``mesh``: an optional :class:`jax.sharding.Mesh` with one axis;
-        the member axis of every vote tensor is sharded across it, so one
-        pod's chips split the pool's planes and the vmapped group step
-        runs SPMD (members are independent — no cross-member collectives
-        are needed; XLA keeps each chip's shard local). ``n_members`` must
-        divide evenly across the mesh."""
+        the member axis of every vote tensor is sharded across it via
+        ``q.shard_map_compat``, so one pod's chips split the pool's
+        planes and the grouped step runs explicit SPMD (members are
+        independent — no cross-member collectives are needed; each
+        chip's shard stays local). ``n_members`` is padded UP to a
+        multiple of the mesh size: the trailing pad rows are real (zero)
+        planes with no member view — they never receive votes, and
+        occupancy accounting excludes them, so a 10-member pool on an
+        8-device mesh costs two idle rows, not a ValueError.
+        ``adaptive_ladder`` hands the padded flush width to an
+        :class:`AdaptiveLadder` (learned per-pool top rung)."""
         self._n = len(validators)
         self._log_size = log_size
         self._n_chk = n_checkpoints
         proto = q.init_state(self._n, log_size, n_checkpoints)
+        self._mesh = mesh
         self._sharding = None
+        self._sharded_fns = None
+        self._n_shards = 1
+        self._shard_rows = n_members
+        self._m_pad = n_members
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             axis = mesh.axis_names[0]
-            if n_members % mesh.devices.size != 0:
-                raise ValueError(
-                    f"n_members={n_members} must divide the "
-                    f"{mesh.devices.size}-device mesh")
+            self._n_shards = int(mesh.devices.size)
+            self._shard_rows = -(-n_members // self._n_shards)  # ceil
+            self._m_pad = self._shard_rows * self._n_shards
             # member axis sharded; everything below it stays local
             self._sharding = lambda ndim: NamedSharding(
                 mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+            self._sharded_fns = _sharded_group_fns(mesh, axis, self._n)
+            # shard index -> owning device, resolved ONCE from the
+            # sharding's own index map (the row-block assignment is
+            # static per mesh; _stage_scatter must not recompute it —
+            # or hop through the default device — per flush)
+            imap = self._sharding(2).devices_indices_map((self._m_pad, 1))
+            self._shard_devices = [None] * self._n_shards
+            for dev, idx in imap.items():
+                self._shard_devices[
+                    (idx[0].start or 0) // self._shard_rows] = dev
+        # real (non-pad) member rows per shard: the capacity denominator
+        # for per-shard occupancy — pad rows can never hold votes and
+        # must not dilute the governor's signal
+        self._real_rows = [
+            min(max(n_members - si * self._shard_rows, 0), self._shard_rows)
+            for si in range(self._n_shards)]
         self._states = jax.tree.map(
-            lambda x: jnp.zeros((n_members,) + x.shape, x.dtype), proto)
+            lambda x: jnp.zeros((self._m_pad,) + x.shape, x.dtype), proto)
         if self._sharding is not None:
             self._states = jax.tree.map(
                 lambda x: jax.device_put(x, self._sharding(x.ndim)),
@@ -407,15 +555,26 @@ class VotePlaneGroup:
         # the dispatch governor
         self.flush_votes_total = 0
         self.flush_capacity_total = 0
-        # reusable host scatter staging: one preallocated (M, B) buffer
-        # per ladder rung — the hot loop stops paying an (M, B) np.zeros
-        # allocation per flush. Reuse is safe ONLY because the device
-        # hand-off is a forced copy (jnp.array, never jnp.asarray): on
-        # jax 0.4.37's CPU backend asarray zero-copies suitably aligned
-        # numpy buffers (allocator luck, reproduced empirically), and an
-        # aliased buffer reused across `_dispatch_pending`'s chained
-        # async dispatches would silently corrupt in-flight vote words.
-        self._scatter_bufs: dict = {}  # rung -> (M, rung) staging buffer
+        # per-shard occupancy series (length 1 when unsharded): in mesh
+        # mode the governor EWMAs each shard separately, so one hot
+        # shard narrows the tick for the whole pool while idle siblings
+        # cannot mask it behind the pool-wide average
+        self.flush_votes_per_shard = [0] * self._n_shards
+        self.flush_capacity_per_shard = [0] * self._n_shards
+        # reusable host scatter staging (UNSHARDED path only): one
+        # preallocated (M, B) buffer per ladder rung — the hot loop
+        # stops paying an (M, B) np.zeros allocation per flush. Reuse is
+        # safe ONLY because the device hand-off is a forced copy
+        # (jnp.array, never jnp.asarray): on jax 0.4.37's CPU backend
+        # asarray zero-copies suitably aligned numpy buffers (allocator
+        # luck, reproduced empirically), and an aliased buffer reused
+        # across `_dispatch_pending`'s chained async dispatches would
+        # silently corrupt in-flight vote words. The mesh path stages
+        # into FRESH per-shard buffers instead (see _stage_scatter) —
+        # never reused, so they ship without the forced copy.
+        self._scatter_bufs: dict = {}
+        # learned top rung (None = static FLUSH_LADDER behaviour)
+        self._ladder = AdaptiveLadder() if adaptive_ladder else None
         # device placement must be justifiable with data: flush count,
         # latency and votes-per-flush land here (injectable for a shared
         # or null collector)
@@ -432,14 +591,19 @@ class VotePlaneGroup:
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
 
-    def _place(self, msgs: q.MsgBatch) -> q.MsgBatch:
-        """Shard the (M, B) message batch like the states, so the group
-        step stays SPMD end-to-end (an unsharded operand would force an
-        all-gather + resharding every flush)."""
-        if self._sharding is None:
-            return msgs
-        return jax.tree.map(
-            lambda x: jax.device_put(x, self._sharding(x.ndim)), msgs)
+    @property
+    def shards(self) -> int:
+        """Mesh shard count (1 when unsharded)."""
+        return self._n_shards
+
+    @property
+    def shard_occupancy(self) -> List[float]:
+        """Cumulative per-shard occupancy (scattered votes / real-row
+        capacity) — THE definition every surface reports (bench, budget
+        gate, profile, dryrun)."""
+        return [round(v / c, 4) if c else 0.0
+                for v, c in zip(self.flush_votes_per_shard,
+                                self.flush_capacity_per_shard)]
 
     def _absorb(self, events: q.QuorumEvents) -> None:
         """ONE bundled device->host transfer into the host snapshot."""
@@ -456,20 +620,51 @@ class VotePlaneGroup:
         return self._inflight is not None
 
     def _stage_scatter(self, chunks: List[List[int]], shape: int):
-        """Pack ``chunks`` into the rung's reusable host buffer and hand
-        the device its own copy (one vectorized row write per member;
-        the staging buffer itself is never reallocated)."""
-        out = self._scatter_bufs.get(shape)
-        if out is None:
-            out = self._scatter_bufs[shape] = np.zeros(
-                (len(self._members), shape), np.uint32)
-        out[...] = 0
+        """Pack ``chunks`` into the rung's reusable host buffer(s) and
+        hand the device its own copy (one vectorized row write per
+        member; the staging buffers themselves are never reallocated).
+
+        Mesh mode stages PER SHARD: each shard's member rows land in a
+        FRESH (rows, shape) buffer shipped straight to that shard's
+        device, then assemble into ONE global member-sharded array — no
+        host-side (M_pad, B) concat, no default-device hop, no
+        device-side resharding on the flush path. Fresh buffers (not the
+        unsharded path's reusable ones): a buffer that is never touched
+        again has no aliasing hazard, so the device hand-off needs no
+        forced copy — one allocation per shard replaces the
+        memset+fill+copy a reused buffer would cost."""
+        if self._mesh is None:
+            out = self._scatter_bufs.get(shape)
+            if out is None:
+                out = self._scatter_bufs[shape] = np.zeros(
+                    (len(self._members), shape), np.uint32)
+            out[...] = 0
+            for i, entries in enumerate(chunks):
+                if entries:
+                    q.fill_words_row(out[i], entries)
+            # forced copy — see the staging-buffer comment in __init__
+            # for why asarray would alias and corrupt in-flight
+            # dispatches
+            return jnp.array(out)
+        bufs = [np.zeros((self._shard_rows, shape), np.uint32)
+                for _ in range(self._n_shards)]
         for i, entries in enumerate(chunks):
             if entries:
-                q.fill_words_row(out[i], entries)
-        # forced copy — see the staging-buffer comment in __init__ for
-        # why asarray would alias and corrupt in-flight dispatches
-        return jnp.array(out)
+                q.fill_words_row(
+                    bufs[i // self._shard_rows][i % self._shard_rows],
+                    entries)
+        arrs = [
+            jax.device_put(buf, dev)
+            for buf, dev in zip(bufs, self._shard_devices)]
+        return jax.make_array_from_single_device_arrays(
+            (self._m_pad, shape), self._sharding(2), arrs)
+
+    def _run_group_step(self, words):
+        """ONE grouped device step over the whole (padded) member axis —
+        shard_map'd under a mesh, plain vmapped jit otherwise."""
+        if self._sharded_fns is not None:
+            return self._sharded_fns[0](self._states, words)
+        return _group_step_words(self._states, words, self._n)
 
     def _dispatch_pending(self):
         """Chunk + scatter every member's pending votes (async dispatch);
@@ -479,36 +674,66 @@ class VotePlaneGroup:
         while any(m._pending for m in self._members):
             chunks = []
             votes = 0
-            for m in self._members:
+            shard_votes = [0] * self._n_shards
+            for i, m in enumerate(self._members):
                 take, m._pending = (m._pending[:FLUSH_BATCH],
                                     m._pending[FLUSH_BATCH:])
                 chunks.append(take)
                 votes += len(take)
+                shard_votes[i // self._shard_rows] += len(take)
             # the padded width rides the busiest member: a quiet tick
             # (a few straggler votes) scatters 16-wide, a full protocol
-            # wave 128-wide — each rung is one cached XLA compilation
-            shape = ladder_shape(max(len(c) for c in chunks))
-            words = self._place(self._stage_scatter(chunks, shape))
-            self._states, events = _group_step_words(
-                self._states, words, self._n)
+            # wave 128-wide — each rung is one cached XLA compilation.
+            # With the adaptive ladder, the top rung is LEARNED from the
+            # observed busiest-member distribution instead of fixed.
+            busiest = max(len(c) for c in chunks)
+            if self._ladder is not None:
+                self._ladder.record(busiest)
+                shape = self._ladder.shape(busiest)
+            else:
+                shape = ladder_shape(busiest)
+            words = self._stage_scatter(chunks, shape)
+            self._states, events = self._run_group_step(words)
             self.flushes += 1
             capacity = len(self._members) * shape
             self.flush_votes_total += votes
             self.flush_capacity_total += capacity
+            self._account_shards(shard_votes, shape)
             self.metrics.add_event(MetricsName.DEVICE_FLUSH)
             self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
             self.metrics.add_event(
                 MetricsName.DEVICE_FLUSH_OCCUPANCY, votes / capacity)
         return events
 
+    def _account_shards(self, shard_votes: List[int], shape: int) -> None:
+        """Fold one dispatch into the per-shard occupancy series (the
+        capacity denominator counts REAL member rows only — pad rows
+        cannot hold votes and must not dilute the governor's signal)."""
+        for si in range(self._n_shards):
+            cap = self._real_rows[si] * shape
+            self.flush_votes_per_shard[si] += shard_votes[si]
+            self.flush_capacity_per_shard[si] += cap
+        if self._n_shards > 1:
+            self.metrics.add_event(
+                MetricsName.DEVICE_SHARD_COUNT, self._n_shards)
+            for si in range(self._n_shards):
+                cap = self._real_rows[si] * shape
+                if cap:
+                    self.metrics.add_event(
+                        f"{MetricsName.DEVICE_SHARD_FLUSH_VOTES}.{si}",
+                        shard_votes[si])
+                    self.metrics.add_event(
+                        f"{MetricsName.DEVICE_SHARD_FLUSH_CAPACITY}.{si}",
+                        cap)
+
     def _dispatch_empty(self):
         """One padded no-vote step (cold start needs SOME events)."""
-        words = self._place(self._stage_scatter(
-            [[] for _ in self._members], FLUSH_LADDER[0]))
-        self._states, events = _group_step_words(
-            self._states, words, self._n)
+        words = self._stage_scatter(
+            [[] for _ in self._members], FLUSH_LADDER[0])
+        self._states, events = self._run_group_step(words)
         self.flushes += 1
         self.flush_capacity_total += len(self._members) * FLUSH_LADDER[0]
+        self._account_shards([0] * self._n_shards, FLUSH_LADDER[0])
         self.metrics.add_event(MetricsName.DEVICE_FLUSH)
         return events
 
@@ -564,12 +789,13 @@ class VotePlaneGroup:
     def slide_member(self, member_idx: int, delta: int) -> None:
         self.flush()
         self._sync_inflight()
-        deltas = np.zeros(len(self._members), np.int32)
+        deltas = np.zeros(self._m_pad, np.int32)
         deltas[member_idx] = delta
-        deltas = jnp.asarray(deltas)
-        if self._sharding is not None:
-            deltas = jax.device_put(deltas, self._sharding(1))
-        self._states = _group_slide(self._states, deltas)
+        if self._sharded_fns is not None:
+            darr = jax.device_put(jnp.array(deltas), self._sharding(1))
+            self._states = self._sharded_fns[1](self._states, darr)
+        else:
+            self._states = _group_slide(self._states, jnp.asarray(deltas))
         self.version += 1
         self._host_prepared = None
 
@@ -577,8 +803,16 @@ class VotePlaneGroup:
         # pending for this member was cleared by the caller; other members'
         # buffered votes are untouched (flushed on their next query)
         self._sync_inflight()  # old-view events must not land post-reset
-        self._states = _group_zero_member(
-            self._states, jnp.int32(member_idx))
+        if self._sharded_fns is not None:
+            # shard_map zero rides a member MASK: a dynamic row index
+            # cannot address a shard-local block, a mask shards trivially
+            mask = np.zeros(self._m_pad, np.uint8)
+            mask[member_idx] = 1
+            marr = jax.device_put(jnp.array(mask), self._sharding(1))
+            self._states = self._sharded_fns[2](self._states, marr)
+        else:
+            self._states = _group_zero_member(
+                self._states, jnp.int32(member_idx))
         self.version += 1
         self._host_prepared = None
 

@@ -1,0 +1,25 @@
+"""Host-platform device provisioning for mesh-sharded CPU runs.
+
+XLA fixes the device topology at backend init, so any script that wants
+a virtual CPU mesh must have ``--xla_force_host_platform_device_count``
+in ``XLA_FLAGS`` before jax initializes a backend. This helper is the
+ONE definition of that append-if-absent dance (bench.py, chaos_run.py,
+check_dispatch_budget.py, profile_rbft.py all provision through it) —
+import-light: it touches ``os.environ`` only, so it is safe to call
+before jax is even imported.
+
+Callers must provision ONLY when a sharded run is actually requested:
+baseline-tracked measurements (kernel benches, the 1-device dispatch
+budgets) are calibrated on the unmodified host topology and must keep
+running there.
+"""
+import os
+
+
+def ensure_host_platform_devices(n: int) -> None:
+    """Append the host-device-count flag if no such flag is present yet
+    (a preset flag — e.g. from tests/conftest.py or the operator — wins)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()

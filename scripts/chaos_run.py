@@ -52,11 +52,36 @@ def main() -> int:
                              "(requires --tick; the report's "
                              "governor.tick_interval metrics record the "
                              "deterministic interval trajectory)")
+    parser.add_argument("--mesh", type=int, default=0,
+                        help="shard the grouped vote plane over this many "
+                             "devices (requires --device-quorum; on CPU "
+                             "the host platform self-provisions virtual "
+                             "devices)")
     args = parser.parse_args()
     if args.tick > 0 and not args.device_quorum:
         parser.error("--tick requires --device-quorum")
     if args.adaptive_tick and args.tick <= 0:
         parser.error("--adaptive-tick requires --tick")
+    if args.mesh > 0 and not args.device_quorum:
+        parser.error("--mesh requires --device-quorum")
+
+    mesh = None
+    if args.mesh > 0:
+        # XLA fixes the device topology at backend init; the flag must
+        # land before the first device query
+        from indy_plenum_tpu.utils.jax_env import (
+            ensure_host_platform_devices,
+        )
+
+        ensure_host_platform_devices(args.mesh)
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if len(devices) < args.mesh:
+            parser.error(f"need {args.mesh} devices, have {len(devices)} "
+                         "(XLA_FLAGS was set too late or preset smaller)")
+        mesh = Mesh(np.array(devices[:args.mesh]), ("members",))
 
     if args.list:
         for name in sorted(SCENARIOS):
@@ -71,7 +96,8 @@ def main() -> int:
                           n_nodes=args.nodes, out_path=out,
                           device_quorum=args.device_quorum,
                           quorum_tick_interval=args.tick,
-                          quorum_tick_adaptive=args.adaptive_tick)
+                          quorum_tick_adaptive=args.adaptive_tick,
+                          mesh=mesh)
     for line in report.summary_lines():
         print(line)
     print(f"  report: {out}")

@@ -18,6 +18,15 @@ adaptive tick exists for) twice, static vs adaptive, and fails if
   run allows after ``--adaptive-tolerance`` slack (the governor must not
   trade dispatches for protocol-time throughput).
 
+Sharded gate (PR 4): unless ``--no-sharded-gate``, the script runs the
+n=16/k=6 workload twice on the SAME seed — once on one device, once with
+the grouped vote plane mesh-sharded over ``--mesh-devices`` host devices
+— and fails if the ordered digests diverge (sharding is a placement
+choice, never a semantics change), if the mesh run's
+``device_dispatches_per_ordered_batch`` drifts beyond
+``--sharded-tolerance`` of the 1-device run, or if its flush occupancy
+falls below the floor.
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
@@ -29,12 +38,28 @@ import os
 import sys
 import time
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+# the sharded gate needs a multi-device host platform, and XLA fixes the
+# device topology at backend init — so the flag must be in the
+# environment before jax initializes. Provision ONLY when that gate will
+# actually run: the 1-device budgets and governor gates are calibrated
+# on the unmodified topology and must keep measuring there.
+if "--no-sharded-gate" not in sys.argv:
+    from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
+
+    _width = 4
+    if "--mesh-devices" in sys.argv:
+        try:
+            _width = int(sys.argv[sys.argv.index("--mesh-devices") + 1])
+        except (IndexError, ValueError):
+            pass  # argparse will reject the malformed value below
+    ensure_host_platform_devices(_width)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 from indy_plenum_tpu.common.metrics_collector import MetricsName  # noqa: E402
 from indy_plenum_tpu.config import getConfig  # noqa: E402
@@ -65,7 +90,7 @@ def _submit_bursty(pool, target: int) -> None:
 
 def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
             tick_interval: float, seed: int = 11, adaptive: bool = False,
-            bursty: bool = False) -> dict:
+            bursty: bool = False, mesh=None) -> dict:
     """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
     measurement: the gate counts every dispatch from pool construction on
     (cold-start/compile steps included), because the budget protects the
@@ -79,7 +104,7 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     })
     pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
                    device_quorum=True, shadow_check=False,
-                   num_instances=instances)
+                   num_instances=instances, mesh=mesh)
 
     def min_ordered():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
@@ -119,7 +144,14 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         "dispatches_per_tick_max": per_tick.max if per_tick else None,
         "ordered_per_sim_second": round(target / sim_elapsed, 2)
         if sim_elapsed else None,
+        # agreement is asserted above, so one node's ordered-digest hash
+        # identifies the whole pool's ordering (the sharded gate compares
+        # it against the 1-device run)
+        "ordered_hash": pool.ordered_hash(),
     }
+    if mesh is not None:
+        result["shards"] = pool.vote_group.shards
+        result["shard_occupancy"] = pool.vote_group.shard_occupancy
     if pool.governor is not None:
         result["governor"] = pool.governor.trajectory_summary()
     return result
@@ -162,6 +194,52 @@ def governor_gates(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def sharded_gates(args) -> "tuple[dict, list]":
+    """1-device vs mesh-sharded on the SAME workload and seed at the
+    acceptance shape (n=16, k=6, 4-way host mesh by default); returns
+    (record, failures). The digests must be bit-identical and the
+    dispatch discipline must survive sharding."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < args.mesh_devices:
+        return ({"skipped": f"need {args.mesh_devices} devices, "
+                            f"have {len(devices)}"},
+                [f"sharded gate needs {args.mesh_devices} host devices "
+                 f"(have {len(devices)}; XLA_FLAGS set too late?)"])
+    mesh = Mesh(np.array(devices[:args.mesh_devices]), ("members",))
+    single = measure(args.sharded_nodes, args.sharded_instances,
+                     args.batches, args.batch_size, args.tick,
+                     seed=args.seed)
+    sharded = measure(args.sharded_nodes, args.sharded_instances,
+                      args.batches, args.batch_size, args.tick,
+                      seed=args.seed, mesh=mesh)
+    tol = args.sharded_tolerance
+    failures = []
+    if sharded["ordered_hash"] != single["ordered_hash"]:
+        failures.append("sharded ordered digests diverge from the "
+                        "1-device run (sharding changed semantics)")
+    s_pb = single["device_dispatches_per_ordered_batch"]
+    m_pb = sharded["device_dispatches_per_ordered_batch"]
+    if s_pb and abs(m_pb - s_pb) > s_pb * tol:
+        failures.append(f"sharded dispatches/batch {m_pb} drifts from "
+                        f"1-device {s_pb} beyond {tol:.0%}")
+    occ = sharded["flush_occupancy_avg"] or 0.0
+    if occ < args.occupancy_floor:
+        failures.append(
+            f"sharded flush_occupancy {occ} < floor {args.occupancy_floor}")
+    record = {
+        "single_device": single,
+        "mesh_sharded": sharded,
+        "mesh_devices": args.mesh_devices,
+        "sharded_tolerance": tol,
+        "digests_match": sharded["ordered_hash"] == single["ordered_hash"],
+        "sharded_dispatch_ratio": round(m_pb / s_pb, 3) if s_pb else None,
+    }
+    return record, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -176,6 +254,20 @@ def main() -> int:
                     help="max device dispatches per delivered message")
     ap.add_argument("--no-governor-gates", action="store_true",
                     help="skip the bursty static-vs-adaptive comparison")
+    ap.add_argument("--no-sharded-gate", action="store_true",
+                    help="skip the 1-device vs mesh-sharded comparison")
+    ap.add_argument("--mesh-devices", type=int, default=4,
+                    help="host mesh width for the sharded gate (the "
+                         "script provisions virtual CPU devices via "
+                         "XLA_FLAGS at import; widths beyond that need "
+                         "the flag preset in the environment)")
+    ap.add_argument("--sharded-nodes", type=int, default=16,
+                    help="pool size for the sharded gate")
+    ap.add_argument("--sharded-instances", type=int, default=6,
+                    help="RBFT instances for the sharded gate")
+    ap.add_argument("--sharded-tolerance", type=float, default=0.10,
+                    help="max fractional dispatches/ordered-batch drift "
+                         "the mesh run may show vs the 1-device run")
     ap.add_argument("--occupancy-floor", type=float, default=0.01,
                     help="min steady-state flush occupancy for the "
                          "adaptive bursty run")
@@ -202,6 +294,10 @@ def main() -> int:
     if not args.no_governor_gates:
         record, failures = governor_gates(args)
         result["governor_gate"] = record
+        over.extend(failures)
+    if not args.no_sharded_gate:
+        record, failures = sharded_gates(args)
+        result["sharded_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

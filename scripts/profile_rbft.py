@@ -8,7 +8,9 @@ goes to stderr): the top-20 cumulative hotspots plus the dispatch-plane
 amortization numbers — ``device_dispatches_per_ordered_batch`` for the
 tick-batched run and, unless ``--no-baseline``, the same measured on a
 short per-message run (``QuorumTickInterval=0``) with the resulting
-``amortization_factor``. The determinism cross-check
+``amortization_factor``. ``--mesh N`` shards the grouped vote plane over
+N host devices (mesh-sharded dispatch plane); the record then carries
+``shards`` and per-shard occupancy. The determinism cross-check
 (``ordered_digests`` identical between the two modes) lives in
 ``tests/test_dispatch_plane.py``; the budget gate in
 ``scripts/check_dispatch_budget.py``.
@@ -21,12 +23,25 @@ import pstats
 import sys
 import time
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 # repo root from this file's location, not a hardcoded absolute path
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# a --mesh run needs the virtual host devices provisioned BEFORE jax
+# initializes its backend. Provision ONLY then: the default unsharded
+# profile's amortization baselines were measured on the unmodified
+# topology and must keep measuring there.
+if "--mesh" in sys.argv:
+    from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
+
+    try:
+        _width = int(sys.argv[sys.argv.index("--mesh") + 1])
+    except (IndexError, ValueError):
+        _width = 8  # argparse will reject the malformed value below
+    ensure_host_platform_devices(max(_width, 1))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 from indy_plenum_tpu.common.metrics_collector import MetricsName  # noqa: E402
 from indy_plenum_tpu.config import getConfig  # noqa: E402
@@ -35,7 +50,7 @@ from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
 BATCH = 160
 
 
-def _build_pool(n, k, tick_interval, adaptive=False):
+def _build_pool(n, k, tick_interval, adaptive=False, mesh=None):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
@@ -43,7 +58,7 @@ def _build_pool(n, k, tick_interval, adaptive=False):
         "QuorumTickAdaptive": adaptive,
     })
     return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
-                   shadow_check=False, num_instances=k)
+                   shadow_check=False, num_instances=k, mesh=mesh)
 
 
 def _run(pool, txns, profile=False):
@@ -113,11 +128,24 @@ def main():
     ap.add_argument("--static-tick", action="store_true",
                     help="freeze the tick at 0.1 (skip the adaptive "
                          "governor the profiled loop now runs by default)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard the grouped vote plane over this many "
+                         "host devices (0 = unsharded)")
     args = ap.parse_args()
     n, k, txns = args.n_nodes, args.instances, args.txns
 
+    mesh = None
+    if args.mesh > 0:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        assert len(devices) >= args.mesh, (
+            f"need {args.mesh} devices, have {len(devices)}")
+        mesh = Mesh(np.array(devices[:args.mesh]), ("members",))
+
     pool = _build_pool(n, k, tick_interval=0.1,
-                       adaptive=not args.static_tick)
+                       adaptive=not args.static_tick, mesh=mesh)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
@@ -147,6 +175,10 @@ def main():
         "ordered_batches": round(batches, 2),
         "device_dispatches_per_ordered_batch": round(per_batch, 2),
         "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
+        # mesh-sharded dispatch plane: mesh width + each shard's
+        # cumulative occupancy (scattered votes / real-row capacity)
+        "shards": pool.vote_group.shards,
+        "shard_occupancy": pool.vote_group.shard_occupancy,
         "effective_tick_interval": (tick_stat.last if tick_stat
                                     else pool.config.QuorumTickInterval),
         "tick_interval_histogram": pool.metrics.histogram(

@@ -1,0 +1,308 @@
+"""Mesh-sharded dispatch plane (PR 4): shard_map group step semantics.
+
+Contract under test (README "Mesh-sharded dispatch plane"): pad M →
+shard → per-shard stage → single grouped step → gathered events.
+Sharding is a PLACEMENT choice — the grouped step on a mesh must order
+bit-identical digests to the 1-device plane on the same seed, through
+view changes, under the adaptive governor, and under chaos. The
+governor's law runs per shard: one hot shard narrows the tick for the
+whole pool.
+
+The heavyweight acceptance shape (n=16/k=6 on a 4-way mesh) rides the
+slow lane; the tier-1 tests pin the same invariants at sizes that fit
+the suite budget. ``scripts/check_dispatch_budget.py``'s sharded gate
+covers the n=16/k=6 dispatch-discipline comparison in CI.
+"""
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+
+
+def _mesh(devices, n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:n]), ("members",))
+
+
+def _run_pool(n_nodes, k, seed, mesh, adaptive=True, view_change=True):
+    """Order a workload (optionally through a view change) and return the
+    surviving nodes' digest map."""
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                     "QuorumTickInterval": 0.05,
+                     "QuorumTickAdaptive": adaptive})
+    pool = SimPool(n_nodes, seed=seed, config=cfg, device_quorum=True,
+                   shadow_check=False, num_instances=k, mesh=mesh)
+    primary = pool.nodes[0].data.primaries[0]
+    for i in range(6):
+        pool.submit_request(i)
+    pool.run_for(8)
+    if view_change:
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 104):
+            pool.submit_request(i)
+        pool.run_for(12)
+    assert pool.honest_nodes_agree()
+    digests = {n.name: tuple(n.ordered_digests) for n in pool.nodes
+               if not view_change or n.name != primary}
+    return digests, pool
+
+
+# ---------------------------------------------------------------------
+# tier-1: semantics identity + the mesh plumbing
+# ---------------------------------------------------------------------
+
+@pytest.mark.perf
+def test_sharded_digest_identity_incl_view_change(eight_devices):
+    """4-way mesh vs 1-device on the same seed, adaptive tick, through a
+    view change: bit-identical ordered digests. (The n=16/k=6 acceptance
+    shape runs in the slow lane and in check_dispatch_budget's sharded
+    gate — this pins the same invariant inside the tier-1 budget.)"""
+    mesh = _mesh(eight_devices, 4)
+    sharded, spool = _run_pool(8, 2, seed=37, mesh=mesh)
+    single, _ = _run_pool(8, 2, seed=37, mesh=None)
+    assert sharded == single
+    assert spool.vote_group.shards == 4
+    # the whole member axis really ran split across the mesh
+    states = spool.vote_group._states.prepare_votes
+    assert len(states.sharding.device_set) == 4
+
+
+def test_member_axis_pads_to_mesh_multiple(eight_devices):
+    """M not divisible by the mesh is padded, not rejected: pad rows are
+    zero planes with no member view, and occupancy accounting excludes
+    them (capacity counts real rows only)."""
+    from indy_plenum_tpu.tpu.vote_plane import FLUSH_LADDER, VotePlaneGroup
+
+    mesh = _mesh(eight_devices, 4)
+    validators = [f"n{i}" for i in range(4)]
+    group = VotePlaneGroup(6, validators, log_size=8, n_checkpoints=2,
+                           mesh=mesh)
+    assert group.shards == 4
+    assert group._m_pad == 8 and group._shard_rows == 2
+    assert group._real_rows == [2, 2, 2, 0]
+    group.view(0).record_preprepare(1)
+    for sender in validators[1:]:
+        group.view(0).record_prepare(sender, 1)
+    group.view(5).record_prepare("n1", 2)
+    group.flush()
+    assert group.view(0).prepare_count(1) == 3
+    assert group.view(5).prepare_count(2) == 1
+    # capacity excludes the pad-only shard entirely
+    assert group.flush_capacity_per_shard[3] == 0
+    assert sum(group.flush_capacity_per_shard) \
+        == 6 * FLUSH_LADDER[0] == group.flush_capacity_total
+    assert sum(group.flush_votes_per_shard) == group.flush_votes_total == 5
+
+
+def test_sharded_slide_and_reset_match_unsharded(eight_devices):
+    """Window slide and view-change reset through the shard_map path
+    leave the same events as the 1-device path."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+
+    def run(mesh):
+        group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                               mesh=mesh)
+        for m in range(4):
+            group.view(m).record_preprepare(2)
+            for sender in validators:
+                group.view(m).record_prepare(sender, 2)
+                group.view(m).record_commit(sender, 2)
+        group.flush()
+        group.view(1).slide_to(1)   # slot axis rolls for member 1 only
+        group.view(2).reset()       # member 2 forgets everything
+        group.flush()
+        return [np.asarray(group._host_prepared)[m].tolist()
+                for m in range(4)]
+
+    assert run(_mesh(eight_devices, 4)) == run(None)
+
+
+def test_monitor_snapshot_surfaces_shards(eight_devices):
+    """Monitor.snapshot()'s device_dispatch block carries the mesh width
+    and per-shard occupancy when the pool runs sharded."""
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                        "PropagateBatchWait": 0.05,
+                        "QuorumTickInterval": 0.05,
+                        "QuorumTickAdaptive": True})
+    pool = NodePool(4, seed=83, config=config, device_quorum=True,
+                    mesh=_mesh(eight_devices, 4))
+    for _ in range(3):
+        pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(15)
+    assert all(len(n.ordered_digests) == 3 for n in pool.nodes)
+    device = pool.node("node0").monitor.snapshot()["device_dispatch"]
+    assert device["shards"] == 4
+    assert len(device["shard_occupancy"]) == 4
+    assert any(occ for occ in device["shard_occupancy"])
+    # the governor saw the per-shard series too
+    assert pool.governor is not None
+    assert pool.governor.shard_ewmas is not None
+    assert len(pool.governor.shard_ewmas) == 4
+
+
+# ---------------------------------------------------------------------
+# per-shard governor law (unit-level, no devices needed)
+# ---------------------------------------------------------------------
+
+def test_governor_hot_shard_narrows_for_everyone():
+    """One saturated shard must narrow the tick even while the pool-wide
+    AVERAGE occupancy sits far below the hot threshold."""
+    from indy_plenum_tpu.tpu.governor import DispatchGovernor
+
+    gov = DispatchGovernor(0.05, 0.01, 0.2, occupancy_high=0.5)
+    interval = gov.observe_shards([60, 0, 0, 0], [64, 64, 64, 64], 1)
+    assert interval < 0.05  # narrowed
+    assert gov.ewma == pytest.approx(60 / 64)  # the hottest shard rules
+    # pool-wide average would have been 60/256 < high: the per-shard law
+    # is what caught it
+    assert (60 / 256) < 0.5
+
+
+def test_governor_single_shard_is_bitwise_pr3_law():
+    """observe() and observe_shards([v],[c],d) must replay identically —
+    unsharded pools keep the exact PR 3 trajectory."""
+    from indy_plenum_tpu.tpu.governor import DispatchGovernor
+
+    a = DispatchGovernor(0.05, 0.01, 0.2)
+    b = DispatchGovernor(0.05, 0.01, 0.2)
+    series = [(10, 64, 1), (0, 0, 1), (60, 64, 2), (1, 64, 1), (0, 0, 1)]
+    for votes, cap, dispatches in series:
+        assert a.observe(votes, cap, dispatches) \
+            == b.observe_shards([votes], [cap], dispatches)
+    assert a.ewma == b.ewma
+    assert list(a.trajectory) == list(b.trajectory)
+
+
+def test_governor_idle_shards_still_widen():
+    """All shards sparse ⇒ widen (the per-shard max must not break the
+    widen half of the law)."""
+    from indy_plenum_tpu.tpu.governor import DispatchGovernor
+
+    gov = DispatchGovernor(0.05, 0.01, 0.2, occupancy_low=0.05)
+    interval = gov.observe_shards([1, 0], [64, 64], 1)
+    assert interval > 0.05
+
+
+# ---------------------------------------------------------------------
+# adaptive flush ladder (unit-level)
+# ---------------------------------------------------------------------
+
+def test_adaptive_ladder_learns_top_rung():
+    from indy_plenum_tpu.tpu.vote_plane import (
+        FLUSH_BATCH,
+        FLUSH_LADDER,
+        AdaptiveLadder,
+        pow2_rung,
+    )
+
+    ladder = AdaptiveLadder(window=512, min_samples=64)
+    # before the warm-up window the static ladder behaviour holds
+    assert ladder.top == FLUSH_BATCH
+    assert ladder.shape(5) == FLUSH_LADDER[0]
+    assert ladder.shape(20) == FLUSH_BATCH
+    for _ in range(64):
+        ladder.record(20)
+    # p99 of a constant-20 series rounds up to 32: the pool stops paying
+    # (and compiling) the 128-wide rung
+    assert ladder.top == 32
+    assert ladder.shape(20) == 32
+    assert ladder.shape(5) == FLUSH_LADDER[0]
+    # overflow beyond the learned top still gets a containing rung
+    assert ladder.shape(100) == FLUSH_BATCH
+    # clamps: pow2 math stays inside the static bounds
+    assert pow2_rung(0) == FLUSH_LADDER[0]
+    assert pow2_rung(FLUSH_BATCH + 1) == FLUSH_BATCH
+
+
+def test_adaptive_ladder_deterministic_and_tracks_p99():
+    from indy_plenum_tpu.tpu.vote_plane import AdaptiveLadder
+
+    def learn(series):
+        ladder = AdaptiveLadder(window=512, min_samples=64)
+        for sample in series:
+            ladder.record(sample)
+        return ladder.top
+
+    series = [3] * 70 + [25] * 2
+    assert learn(series) == learn(series)  # pure function of the series
+    assert learn([3] * 70) == 16
+    # the p99 follows a heavy tail present at the recompute point
+    assert learn([3] * 50 + [60] * 14) == 64
+    # recomputes happen on a stride (not per record — the flush loop
+    # must not pay a window sort per dispatch): a tail landing between
+    # strides folds in at the next boundary
+    assert learn([3] * 64 + [60] * 31) == 16   # tail not yet folded
+    assert learn([3] * 64 + [60] * 32) == 64   # stride boundary hit
+
+
+def test_group_uses_learned_rung():
+    """End-to-end through VotePlaneGroup: after the warm-up window a
+    ~20-vote busiest member pads to the learned 32-wide rung, not 128."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+    group = VotePlaneGroup(2, validators, log_size=64, n_checkpoints=2,
+                           adaptive_ladder=True)
+    ladder = group._ladder
+    assert ladder is not None
+    for _ in range(64):
+        ladder.record(20)
+    for slot in range(20):
+        group.view(0).record_prepare("n1", slot + 1)
+    group.flush()
+    # capacity for the last dispatch: members * learned rung
+    assert group.flush_capacity_total == 2 * 32
+
+
+# ---------------------------------------------------------------------
+# slow lane: the acceptance shape + chaos on the mesh path
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.perf
+def test_sharded_digest_identity_n16_k6(eight_devices):
+    """The ISSUE 4 acceptance shape: n=16, k=6 (M=96 members) on a 4-way
+    host mesh vs 1-device, adaptive governor, through a view change —
+    bit-identical ordered digests."""
+    mesh = _mesh(eight_devices, 4)
+    sharded, spool = _run_pool(16, 6, seed=41, mesh=mesh)
+    single, _ = _run_pool(16, 6, seed=41, mesh=None)
+    assert sharded == single
+    assert spool.vote_group.shards == 4
+    assert spool.vote_group._m_pad == 96
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_f_crash_partition_on_mesh_matches_single_device(eight_devices):
+    """f crash + partition through the MESH-SHARDED dispatch plane: all
+    invariants hold and every node's ordered-digest hash equals the
+    1-device run on the same seed (the chaos replay contract extends to
+    placement)."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    mesh = _mesh(eight_devices, 4)
+    sharded = run_scenario("f_crash_partition", seed=7,
+                           device_quorum=True, quorum_tick_interval=0.05,
+                           quorum_tick_adaptive=True, mesh=mesh)
+    assert sharded.verdict_as_expected, sharded.failed
+    assert not sharded.expected_failures
+    assert sharded.metrics.get("device.dispatches_per_tick")
+    single = run_scenario("f_crash_partition", seed=7,
+                          device_quorum=True, quorum_tick_interval=0.05,
+                          quorum_tick_adaptive=True)
+    assert sharded.ordered_hash_per_node == single.ordered_hash_per_node

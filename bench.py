@@ -182,11 +182,15 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "QuorumTickInterval": 0.1,
         "QuorumTickAdaptive": True,
     })
+    # flight recorder on: the phase split below is what lets a future
+    # BENCH_r*.json attribute a throughput regression to a phase instead
+    # of just detecting it (overhead is gated <=5% ordered/sim-sec by
+    # scripts/check_dispatch_budget.py's tracing gate)
     pool = SimPool(n_nodes=n_nodes, seed=11, config=config,
                    device_quorum=True, shadow_check=False,
                    num_instances=num_instances,
                    host_accounting=host_accounting,
-                   pipelined_flush=True, mesh=mesh)
+                   pipelined_flush=True, mesh=mesh, trace=True)
 
     seq = 0
 
@@ -258,6 +262,17 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "ordered_hash": pool.ordered_hash(),
         "shards": pool.vote_group.shards,
     }
+    # per-phase latency attribution (VIRTUAL protocol time): which 3PC
+    # phase the ordered batches spent their latency in, and which phase
+    # dominated — regressions in future rounds become attributable
+    from indy_plenum_tpu.observability.trace import (
+        critical_path,
+        phase_percentiles,
+    )
+
+    trace_events = pool.trace.events()
+    out["phase_latency"] = phase_percentiles(trace_events)
+    out["critical_path"] = critical_path(trace_events)
     if mesh is not None:
         out["shard_occupancy"] = pool.vote_group.shard_occupancy
     if pool.governor is not None:
@@ -958,7 +973,8 @@ def main() -> None:
                                         "vs_baseline")}
     if extras:
         # [value, vs_baseline] (+ flush_occupancy, + the governor's
-        # [tick_min, tick_median, tick_max, occupancy_ewma] for the
+        # [tick_min, tick_median, tick_max, occupancy_ewma], + the
+        # flight recorder's per-phase share of batch latency for the
         # tick-batched ordered sub-benches — index-based consumers keep
         # [0]/[1])
         def _extras_digest(e):
@@ -969,6 +985,9 @@ def main() -> None:
             if gov:
                 row.append([gov["interval_min"], gov["interval_median"],
                             gov["interval_max"], gov["occupancy_ewma"]])
+            cp = e.get("critical_path")
+            if cp and cp.get("phase_share"):
+                row.append(cp["phase_share"])
             return row
 
         compact["extras"] = {e["metric"]: _extras_digest(e)

@@ -42,6 +42,15 @@ class ChaosReport:
     # unsharded would still order identically (that's the tested
     # contract) but would no longer exercise the path being debugged
     dispatch_mode: Dict[str, Any] = field(default_factory=dict)
+    # consensus flight recorder (observability.trace): the trace
+    # fingerprint (bit-identical across replays of the same seed), where
+    # the full JSONL dump landed, and every triggered tail snapshot
+    # (invariant violation / ordering stall / governor anomaly) — the
+    # report carries the flight-recorder moment itself, replayable via
+    # replay_command
+    trace_hash: Optional[str] = None
+    trace_file: Optional[str] = None
+    flight_recorder: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def failed(self) -> List[str]:
@@ -67,6 +76,8 @@ class ChaosReport:
             cmd += " --adaptive-tick"
         if mode.get("mesh"):
             cmd += f" --mesh {mode['mesh']}"
+        if mode.get("trace"):
+            cmd += " --trace"
         return cmd
 
     def as_dict(self) -> Dict[str, Any]:
@@ -91,6 +102,9 @@ class ChaosReport:
             "first_violation": (list(self.first_violation)
                                 if self.first_violation else None),
             "virtual_seconds": self.virtual_seconds,
+            "trace_hash": self.trace_hash,
+            "trace_file": self.trace_file,
+            "flight_recorder": self.flight_recorder,
         }
 
     def to_json(self, indent: int = 2) -> str:
@@ -116,5 +130,11 @@ class ChaosReport:
         if self.first_violation is not None:
             t, what = self.first_violation
             lines.append(f"  first violation at t={t:.2f}: {what}")
+        if self.trace_hash is not None:
+            dumped = ", ".join(sorted({d.get("reason", "?")
+                                       for d in self.flight_recorder})) \
+                or "none"
+            lines.append(f"  trace: hash={self.trace_hash[:16]}… "
+                         f"file={self.trace_file} flight_dumps={dumped}")
         lines.append(f"  replay: {self.replay_command}")
         return lines

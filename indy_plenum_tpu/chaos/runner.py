@@ -46,7 +46,9 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                  device_quorum: bool = False,
                  quorum_tick_interval: float = 0.0,
                  quorum_tick_adaptive: bool = False,
-                 mesh=None) -> ChaosReport:
+                 mesh=None,
+                 trace: bool = False,
+                 trace_out: Optional[str] = None) -> ChaosReport:
     """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
     through the tick-batched dispatch plane (grouped device flushes, per-
     tick quorum evaluation) — fault paths must survive the tick barrier
@@ -59,7 +61,15 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     ``mesh`` shards the grouped vote plane's member axis across a jax
     device mesh — fault paths must survive the mesh-sharded dispatch
     plane bit-for-bit (``ordered_hash_per_node`` equal to the 1-device
-    run on the same seed), which the slow-lane mesh chaos test asserts."""
+    run on the same seed), which the slow-lane mesh chaos test asserts.
+    ``trace`` arms the consensus flight recorder on the pool's virtual
+    clock: fault begin/end marks and the full 3PC/dispatch span timeline
+    land in one ring, the first invariant violation (and any ordering
+    stall / governor anomaly) snapshots its tail into the report's
+    ``flight_recorder``, and the report carries ``trace_hash`` — a
+    replay of the same seed must reproduce it bit-for-bit.
+    ``trace_out`` additionally dumps the whole ring as JSONL
+    (``scripts/trace_tool.py`` consumes it)."""
     if mesh is not None and not device_quorum:
         raise ValueError("mesh requires device_quorum")
     if quorum_tick_interval > 0 and not device_quorum:
@@ -80,7 +90,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
     config = getConfig(overrides)
     pool = SimPool(n_nodes=n, seed=seed, config=config,
-                   device_quorum=device_quorum, mesh=mesh)
+                   device_quorum=device_quorum, mesh=mesh, trace=trace)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,
@@ -117,6 +127,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             "tick": quorum_tick_interval,
             "adaptive": quorum_tick_adaptive,
             "mesh": int(mesh.devices.size) if mesh is not None else 0,
+            "trace": trace,
         },
         plan=plan.as_dicts(),
         trace=list(scheduler.trace),
@@ -139,6 +150,16 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         virtual_seconds=pool.timer.get_current_time()
         - 1_700_000_000.0,
     )
+    if trace:
+        # serialize the ring ONCE: the hash and the dump are the same
+        # bytes by construction
+        jsonl = pool.trace.to_jsonl()
+        report.trace_hash = hashlib.sha256(jsonl.encode()).hexdigest()
+        report.flight_recorder = [dict(d) for d in pool.trace.dumps]
+        if trace_out is not None:
+            with open(trace_out, "w") as fh:
+                fh.write(jsonl)
+            report.trace_file = trace_out
     if out_path is not None:
         report.save(out_path)
     return report

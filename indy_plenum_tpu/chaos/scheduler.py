@@ -14,6 +14,7 @@ import random
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..common.timer import RepeatingTimer
+from ..observability.trace import NULL_TRACE
 from .faults import Fault, FaultContext, FaultPlan
 
 
@@ -25,6 +26,13 @@ class FaultScheduler:
         self.plan = plan
         self.rng = random.Random(plan.seed)
         self.trace: List[Tuple[float, str]] = []
+        # flight recorder: fault begin/end marks ride the pool's span
+        # trace too (cat "chaos"), and the FIRST safety violation dumps
+        # the trace tail — the run's forensic record at the moment it
+        # went wrong, not just post-mortem
+        pool_trace = getattr(pool, "trace", None)
+        self._span_trace = pool_trace if pool_trace is not None \
+            else NULL_TRACE
         self.active_faults = 0
         self.probe_results: List[Tuple[float, bool]] = []
         self.first_violation: Optional[Tuple[float, str]] = None
@@ -39,6 +47,8 @@ class FaultScheduler:
 
     def _record(self, event: str) -> None:
         self.trace.append((self.pool.timer.get_current_time(), event))
+        if self._span_trace.enabled:
+            self._span_trace.record(event, cat="chaos")
 
     # --- wiring ---------------------------------------------------------
 
@@ -87,3 +97,6 @@ class FaultScheduler:
             self.first_violation = (
                 self.pool.timer.get_current_time(), failed)
             self._record("safety violation: " + failed)
+            if self._span_trace.enabled:
+                self._span_trace.trigger_dump("invariant_violation",
+                                              args={"failed": failed})

@@ -138,11 +138,23 @@ class MetricsCollector:
 
     @contextmanager
     def measure_time(self, name: str):
+        """Time the body into ``name`` — EXCEPT when it raises: failure
+        paths land under ``<name>.error`` instead, so a retry storm of
+        raising bodies can never pollute the hot-path latency stats the
+        dispatch plane is judged by (and the error count is itself an
+        observable)."""
         t0 = time.perf_counter()
         try:
             yield
-        finally:
+        except BaseException:
+            self.add_event(name + ".error", time.perf_counter() - t0)
+            raise
+        else:
             self.add_event(name, time.perf_counter() - t0)
+
+    def close(self) -> None:
+        """Teardown hook: persistent collectors flush; the in-memory
+        base has nothing to do."""
 
 
 class NullMetricsCollector(MetricsCollector):
@@ -159,12 +171,21 @@ class NullMetricsCollector(MetricsCollector):
         yield
 
 
+# histogram entries share the stat keyspace; the prefix keeps them
+# distinguishable (no metric name starts with it — MetricsName values
+# are dotted lowercase words)
+_HISTOGRAM_KEY_PREFIX = "hist!"
+
+
 class KvMetricsCollector(MetricsCollector):
     """Persists summary snapshots into a KV store (reference: the
     KvStoreMetricsCollector's accumulated storage). Re-opening over a
-    non-empty store SEEDS the counters from the persisted snapshot, so
-    history genuinely survives restarts instead of being overwritten by
-    the new process's counters."""
+    non-empty store SEEDS the counters from the persisted snapshot —
+    stats AND histograms (``governor.tick_interval`` dwell history
+    included), so history genuinely survives restarts instead of being
+    overwritten by the new process's counters. ``close()`` flushes the
+    up-to-``flush_every - 1`` events a periodic-only flush would lose on
+    a clean shutdown — Node teardown calls it."""
 
     def __init__(self, store, flush_every: int = 1000):
         super().__init__()
@@ -178,6 +199,8 @@ class KvMetricsCollector(MetricsCollector):
             stat.min = snap.get("min")
             stat.max = snap.get("max")
             stat.last = snap.get("last")
+        for name, hist in self.load_persisted_histograms().items():
+            self._histograms[name] = dict(hist)
 
     def add_event(self, name: str, value: float = 1.0) -> None:
         super().add_event(name, value)
@@ -192,11 +215,42 @@ class KvMetricsCollector(MetricsCollector):
         for name, stat in self._stats.items():
             self._store.put(name.encode(),
                             json.dumps(stat.as_dict()).encode())
+        for name, hist in self._histograms.items():
+            # [bucket, count] pairs, not an object: JSON object keys are
+            # strings, and the governor's float buckets must round-trip
+            # as floats
+            self._store.put(
+                (_HISTOGRAM_KEY_PREFIX + name).encode(),
+                json.dumps(sorted(
+                    ([b, c] for b, c in hist.items()),
+                    key=lambda pair: str(pair[0]))).encode())
+
+    def close(self) -> None:
+        self.flush()
 
     def load_persisted(self) -> Dict[str, Dict[str, Any]]:
         import json
 
         out = {}
         for key, value in self._store.iterator():
-            out[bytes(key).decode()] = json.loads(bytes(value))
+            name = bytes(key).decode()
+            if name.startswith(_HISTOGRAM_KEY_PREFIX):
+                continue
+            out[name] = json.loads(bytes(value))
+        return out
+
+    def load_persisted_histograms(self) -> Dict[str, Dict[Any, int]]:
+        import json
+
+        out: Dict[str, Dict[Any, int]] = {}
+        for key, value in self._store.iterator():
+            name = bytes(key).decode()
+            if not name.startswith(_HISTOGRAM_KEY_PREFIX):
+                continue
+            pairs = json.loads(bytes(value))
+            out[name[len(_HISTOGRAM_KEY_PREFIX):]] = {
+                # JSON has no tuple/int-key subtleties for our buckets
+                # (floats and strings); lists would be unhashable, guard
+                (tuple(b) if isinstance(b, list) else b): c
+                for b, c in pairs}
         return out

@@ -133,6 +133,14 @@ class Config:
     METRICS_COLLECTOR_TYPE: Optional[str] = "kv"
     METRICS_FLUSH_INTERVAL: float = 10.0
     RECORDER_ENABLED: bool = False
+    # consensus flight recorder (observability.trace): span traces for
+    # the 3PC lifecycle + dispatch plane. Disabled by default — recording
+    # rides NULL_TRACE (zero-cost, like NullMetricsCollector); sim pools
+    # enable it explicitly (trace=True) on the virtual clock so seeded
+    # runs dump bit-identical traces, a deployed Node enables it here and
+    # records perf_counter durations instead.
+    TraceRecorderEnabled: bool = False
+    TraceRecorderCapacity: int = 65536
     # logging (reference: stp logging config + rotating handler)
     logLevel: str = "INFO"
     logRotationMaxBytes: int = 10 * 1024 * 1024

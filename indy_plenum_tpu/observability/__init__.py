@@ -1,0 +1,11 @@
+"""Consensus flight recorder: deterministic span traces for the 3PC
+lifecycle and the dispatch plane (README "Observability")."""
+from .trace import (  # noqa: F401
+    NULL_TRACE,
+    NullTraceRecorder,
+    TraceRecorder,
+    critical_path,
+    phase_durations,
+    phase_percentiles,
+    to_chrome_trace,
+)

@@ -1,0 +1,420 @@
+"""Consensus flight recorder: bounded, deterministic span traces.
+
+RBFT's safety-against-slowness argument (Aublin et al., ICDCS 2013) rests
+on *measuring* where time goes; the aggregate counters in
+:mod:`~indy_plenum_tpu.common.metrics_collector` say how much, never
+*where*. This module is the missing span layer (Dapper-style request
+tracing, Sigelman et al. 2010): a ring-buffer :class:`TraceRecorder`
+captures structured events for
+
+- the per-batch 3PC lifecycle, keyed ``(view_no, pp_seq_no, digest)``:
+  ``3pc.preprepare_sent`` (primary) / ``3pc.preprepare`` (applied) →
+  ``3pc.prepare_quorum`` → ``3pc.commit_quorum`` → ``3pc.ordered`` →
+  ``3pc.executed``, plus per-request ``req.ingress`` → ``req.finalised``
+  marks (the auth phase) keyed by request digest;
+- the per-tick dispatch plane (cat ``dispatch``): ``tick.drain``,
+  ``flush.dispatch`` (one per grouped device step, with votes/shape/
+  shard occupancy), ``flush.readback``, ``tick.flush``, ``tick.eval``,
+  ``tick.governor``;
+- flight events (cat ``flight``): chaos invariant violations, the
+  ordering-stall watchdog firing, governor saturation anomalies. Each
+  one snapshots the ring's tail (:meth:`TraceRecorder.trigger_dump`) —
+  the "flight recorder" moment.
+
+Determinism contract: the clock is INJECTED. Simulation pools hand in
+``MockTimer.get_current_time`` (logical time), so a seeded run — chaos
+and mesh runs included — produces a **bit-identical** JSONL dump,
+checkable like ``SimPool.ordered_hash()`` (``trace_hash``). Deployed
+nodes inject ``time.perf_counter`` and trade determinism for real
+durations. Recording must cost ~nothing when disabled:
+:data:`NULL_TRACE` (a :class:`NullTraceRecorder`) mirrors
+``NullMetricsCollector`` — every hot-path call site guards non-trivial
+argument construction behind ``trace.enabled``.
+
+``scripts/trace_tool.py`` consumes dumps: per-phase latency percentiles,
+critical-path breakdown per ordered batch, and Chrome trace-event JSON
+(:func:`to_chrome_trace`) loadable in Perfetto.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import deque
+from contextlib import contextmanager, nullcontext
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# disabled-trace fast path: a shared no-op context manager (nullcontext
+# is reentrant and reusable) so call-site span guards stay one branch:
+# ``with trace.span(...) if trace.enabled else _NO_SPAN:``
+_NO_SPAN = nullcontext()
+
+DEFAULT_CAPACITY = 65536
+# tail size snapshotted by a flight trigger, and how many triggered
+# dumps the recorder retains (oldest evicted): a storm of stall votes
+# must not grow memory without bound
+FLIGHT_TAIL = 512
+MAX_FLIGHT_DUMPS = 8
+
+# canonical 3PC phase chain: each phase is the delta between two
+# lifecycle marks for the same (node, key) group. ``commit_quorum`` is
+# recorded when the service OBSERVES the quorum (in tick mode that is
+# the tick instant), so ``order`` captures only the in-order delivery
+# wait on top of it.
+PHASES: Tuple[Tuple[str, str, str], ...] = (
+    ("prepare", "3pc.preprepare", "3pc.prepare_quorum"),
+    ("commit", "3pc.prepare_quorum", "3pc.commit_quorum"),
+    ("order", "3pc.commit_quorum", "3pc.ordered"),
+    ("execute", "3pc.ordered", "3pc.executed"),
+    ("total_3pc", "3pc.preprepare", "3pc.executed"),
+)
+AUTH_PHASE = ("auth", "req.ingress", "req.finalised")
+
+
+class TraceRecorder:
+    """Bounded ring buffer of span events on an injected clock."""
+
+    enabled = True
+
+    def __init__(self, clock: Callable[[], float],
+                 capacity: int = DEFAULT_CAPACITY, node: str = "",
+                 flight_tail: int = FLIGHT_TAIL):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._clock = clock
+        self.capacity = capacity
+        self.node = node
+        self.flight_tail = flight_tail
+        # (seq, ts, name, cat, node, key, dur, args) — tuples, not dicts:
+        # one append per event on the hot path, serialization is lazy
+        self._events: "deque[tuple]" = deque(maxlen=capacity)
+        self._seq = 0
+        # triggered flight dumps: {"reason", "ts", "seq", "events"}
+        self.dumps: "deque[dict]" = deque(maxlen=MAX_FLIGHT_DUMPS)
+
+    # --- recording ------------------------------------------------------
+
+    def record(self, name: str, cat: str = "3pc", node: str = "",
+               key: Optional[Sequence] = None, dur: Optional[float] = None,
+               args: Optional[Dict[str, Any]] = None,
+               ts: Optional[float] = None) -> None:
+        self._seq += 1
+        self._events.append(
+            (self._seq, self._clock() if ts is None else ts, name, cat,
+             node or self.node, tuple(key) if key is not None else None,
+             dur, args))
+
+    @contextmanager
+    def span(self, name: str, cat: str = "dispatch", node: str = "",
+             args: Optional[Dict[str, Any]] = None):
+        """Record a complete span (``dur`` = clock delta around the body).
+        Under a virtual clock the duration is 0 unless the body advances
+        the clock — the *sequence* is the deterministic signal; real
+        durations come from ``perf_counter`` on deployed nodes."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.record(name, cat=cat, node=node, args=args, ts=t0,
+                        dur=self._clock() - t0)
+
+    # --- flight-recorder triggers --------------------------------------
+
+    def trigger_dump(self, reason: str, node: str = "",
+                     args: Optional[Dict[str, Any]] = None) -> dict:
+        """The flight-recorder moment: record a ``flight.<reason>`` mark,
+        then snapshot the ring's tail (mark included) into :attr:`dumps`.
+        Returns the snapshot so callers (chaos reports) can attach it."""
+        self.record("flight." + reason, cat="flight", node=node, args=args)
+        snap = {"reason": reason, "ts": self._events[-1][1],
+                "seq": self._seq, "events": self.tail(self.flight_tail)}
+        self.dumps.append(snap)
+        return snap
+
+    # --- reading / dumping ---------------------------------------------
+
+    @staticmethod
+    def _as_dict(ev: tuple) -> Dict[str, Any]:
+        seq, ts, name, cat, node, key, dur, args = ev
+        out: Dict[str, Any] = {"seq": seq, "ts": ts, "name": name,
+                               "cat": cat}
+        if node:
+            out["node"] = node
+        if key is not None:
+            out["key"] = list(key)
+        if dur is not None:
+            out["dur"] = dur
+        if args:
+            out["args"] = args
+        return out
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def __bool__(self) -> bool:
+        # a recorder is never falsy: with __len__ defined, an enabled
+        # but still-empty ring would otherwise fail `trace or NULL_TRACE`
+        # style guards and silently drop everything
+        return True
+
+    def events(self) -> List[Dict[str, Any]]:
+        return [self._as_dict(ev) for ev in self._events]
+
+    def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        if n is None or n >= len(self._events):
+            return self.events()
+        take = list(self._events)[len(self._events) - n:]
+        return [self._as_dict(ev) for ev in take]
+
+    def to_jsonl(self) -> str:
+        return events_to_jsonl(self.events())
+
+    def dump(self, path: str, tail: Optional[int] = None) -> str:
+        with open(path, "w") as fh:
+            fh.write(events_to_jsonl(self.tail(tail)))
+        return path
+
+    def trace_hash(self) -> str:
+        """sha256 of the JSONL serialization — THE trace fingerprint
+        (seeded runs must reproduce it bit-for-bit, like
+        ``ordered_hash``)."""
+        return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
+
+    def clear(self) -> None:
+        self._events.clear()
+        self.dumps.clear()
+
+
+class NullTraceRecorder(TraceRecorder):
+    """Zero-cost sink: the default wherever tracing is not requested.
+    Call sites additionally guard argument construction behind
+    ``trace.enabled`` so a disabled recorder costs one attribute load
+    and one no-op call."""
+
+    enabled = False
+
+    def __init__(self):
+        super().__init__(clock=lambda: 0.0, capacity=1)
+
+    def record(self, name, cat="3pc", node="", key=None, dur=None,
+               args=None, ts=None) -> None:
+        pass
+
+    @contextmanager
+    def span(self, name, cat="dispatch", node="", args=None):
+        yield
+
+    def trigger_dump(self, reason, node="", args=None) -> dict:
+        return {"reason": reason, "ts": 0.0, "seq": 0, "events": []}
+
+
+NULL_TRACE = NullTraceRecorder()
+
+
+# ----------------------------------------------------------------------
+# serialization
+# ----------------------------------------------------------------------
+
+def events_to_jsonl(events: List[Dict[str, Any]]) -> str:
+    """One sorted-key JSON object per line: the canonical dump format
+    (byte-stable for identical event sequences)."""
+    return "".join(
+        json.dumps(ev, sort_keys=True, separators=(",", ":")) + "\n"
+        for ev in events)
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+# ----------------------------------------------------------------------
+# phase analytics
+# ----------------------------------------------------------------------
+
+def _mark_times(events: List[Dict[str, Any]], cat: str,
+                nodes: Optional[frozenset]
+                ) -> Dict[tuple, Dict[str, float]]:
+    """(node, key) -> {mark name -> earliest ts} for one category;
+    ``nodes`` filters to that set (None = every node)."""
+    groups: Dict[tuple, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("cat") != cat or ev.get("key") is None:
+            continue
+        ev_node = ev.get("node", "")
+        if nodes is not None and ev_node not in nodes:
+            continue
+        marks = groups.setdefault((ev_node, tuple(ev["key"])), {})
+        name = ev["name"]
+        if name not in marks or ev["ts"] < marks[name]:
+            marks[name] = ev["ts"]
+    return groups
+
+
+def phase_durations(events: List[Dict[str, Any]],
+                    node: Optional[str] = None) -> Dict[str, List[float]]:
+    """Per-phase duration samples from lifecycle marks. ``node=None``
+    aggregates every node's samples (request marks recorded pool-level
+    under node ``""`` are always included — the auth phase is a pool
+    observation, not a per-replica one)."""
+    out: Dict[str, List[float]] = {}
+    for (_node, _key), marks in sorted(
+            _mark_times(events, "3pc",
+                        None if node is None
+                        else frozenset((node,))).items()):
+        # the primary's own batch has no applied mark; its send mark is
+        # the honest phase start
+        if "3pc.preprepare" not in marks \
+                and "3pc.preprepare_sent" in marks:
+            marks["3pc.preprepare"] = marks["3pc.preprepare_sent"]
+        for phase, start, end in PHASES:
+            if start in marks and end in marks:
+                out.setdefault(phase, []).append(
+                    marks[end] - marks[start])
+    # auth phase: ingress happens on whichever node the client hit (or
+    # pool-level under node ""), finalisation on EVERY node — so the
+    # join runs per request digest across nodes: earliest ingress
+    # anywhere → earliest finalisation on the filtered node
+    ingress_ts: Dict[tuple, float] = {}
+    finalised_ts: Dict[tuple, float] = {}
+    for ev in events:
+        if ev.get("cat") != "req" or ev.get("key") is None:
+            continue
+        k = tuple(ev["key"])
+        if ev["name"] == AUTH_PHASE[1]:
+            if k not in ingress_ts or ev["ts"] < ingress_ts[k]:
+                ingress_ts[k] = ev["ts"]
+        elif ev["name"] == AUTH_PHASE[2]:
+            if node is not None and ev.get("node", "") not in (node, ""):
+                continue
+            if k not in finalised_ts or ev["ts"] < finalised_ts[k]:
+                finalised_ts[k] = ev["ts"]
+    for k in sorted(finalised_ts):
+        if k in ingress_ts:
+            out.setdefault(AUTH_PHASE[0], []).append(
+                finalised_ts[k] - ingress_ts[k])
+    return out
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile over a SORTED sample list (deterministic:
+    no interpolation)."""
+    if not samples:
+        return 0.0
+    rank = max(1, -(-len(samples) * q // 100))  # ceil without floats
+    return samples[int(rank) - 1]
+
+
+def phase_percentiles(events: List[Dict[str, Any]],
+                      node: Optional[str] = None,
+                      ndigits: int = 6) -> Dict[str, Dict[str, float]]:
+    """{phase: {count, p50, p90, p99, max}} — the ``phase_latency``
+    block every surface reports (Monitor.snapshot, profile_rbft --json,
+    bench ordered sub-benches, trace_tool)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for phase, samples in phase_durations(events, node=node).items():
+        s = sorted(samples)
+        out[phase] = {
+            "count": len(s),
+            "p50": round(percentile(s, 50), ndigits),
+            "p90": round(percentile(s, 90), ndigits),
+            "p99": round(percentile(s, 99), ndigits),
+            "max": round(s[-1], ndigits),
+        }
+    return out
+
+
+# breakdown phases only (no overlapping total) — critical-path shares
+# must sum to ~1.0 over an ordered batch's life
+_BREAKDOWN = ("prepare", "commit", "order", "execute")
+
+
+def critical_path(events: List[Dict[str, Any]],
+                  node: Optional[str] = None) -> Dict[str, Any]:
+    """Per ordered batch: which phase dominated its latency. Returns
+    ``batches`` (groups with a complete breakdown), ``dominant`` (phase
+    -> how many batches it dominated) and ``phase_share`` (phase ->
+    fraction of total attributed time pool-wide)."""
+    dominant: Dict[str, int] = {}
+    totals: Dict[str, float] = {}
+    batches = 0
+    for (_node, _key), marks in sorted(
+            _mark_times(events, "3pc",
+                        None if node is None
+                        else frozenset((node,))).items()):
+        if "3pc.preprepare" not in marks \
+                and "3pc.preprepare_sent" in marks:
+            marks["3pc.preprepare"] = marks["3pc.preprepare_sent"]
+        durs = {}
+        for phase, start, end in PHASES:
+            if phase in _BREAKDOWN and start in marks and end in marks:
+                durs[phase] = marks[end] - marks[start]
+        if not durs:
+            continue
+        batches += 1
+        # ties break on canonical phase order (deterministic)
+        top, top_d = None, float("-inf")
+        for phase in _BREAKDOWN:
+            if phase in durs and durs[phase] > top_d:
+                top, top_d = phase, durs[phase]
+        dominant[top] = dominant.get(top, 0) + 1
+        for phase, d in durs.items():
+            totals[phase] = totals.get(phase, 0.0) + d
+    whole = sum(totals.values())
+    return {
+        "batches": batches,
+        "dominant": {p: dominant[p] for p in _BREAKDOWN if p in dominant},
+        "phase_share": {p: round(totals[p] / whole, 4)
+                        for p in _BREAKDOWN if p in totals} if whole
+        else {},
+    }
+
+
+# ----------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+# ----------------------------------------------------------------------
+
+def to_chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON: one pid per node (pool-level events ride
+    pid "pool"), one tid per category; spans (events with ``dur``) become
+    complete "X" events, marks become instant "i" events. Timestamps are
+    microseconds per the format spec."""
+    nodes = sorted({ev.get("node", "") for ev in events})
+    cats = sorted({ev.get("cat", "") for ev in events})
+    pid_of = {n: i + 1 for i, n in enumerate(nodes)}
+    tid_of = {c: i + 1 for i, c in enumerate(cats)}
+    out: List[Dict[str, Any]] = []
+    for n in nodes:
+        out.append({"ph": "M", "name": "process_name", "pid": pid_of[n],
+                    "tid": 0, "args": {"name": n or "pool"}})
+    for c in cats:
+        for n in nodes:
+            out.append({"ph": "M", "name": "thread_name",
+                        "pid": pid_of[n], "tid": tid_of[c],
+                        "args": {"name": c}})
+    t0 = min((ev["ts"] for ev in events), default=0.0)
+    for ev in events:
+        args = dict(ev.get("args") or {})
+        if ev.get("key") is not None:
+            args["key"] = list(ev["key"])
+        rec: Dict[str, Any] = {
+            "name": ev["name"],
+            "cat": ev.get("cat", ""),
+            "pid": pid_of[ev.get("node", "")],
+            "tid": tid_of[ev.get("cat", "")],
+            "ts": round((ev["ts"] - t0) * 1e6, 3),
+        }
+        if args:
+            rec["args"] = args
+        if ev.get("dur") is not None:
+            rec["ph"] = "X"
+            rec["dur"] = round(ev["dur"] * 1e6, 3)
+        else:
+            rec["ph"] = "i"
+            rec["s"] = "p"
+        out.append(rec)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -54,6 +54,7 @@ from ...common.stashing_router import (
 )
 from ...common.timer import RepeatingTimer, TimerService
 from ...common.constants import DOMAIN_LEDGER_ID
+from ...observability.trace import NULL_TRACE
 from ..suspicion_codes import Suspicions
 from .consensus_shared_data import (
     BatchID,
@@ -157,7 +158,8 @@ class OrderingService:
                  config=None,
                  get_time=None,
                  vote_plane=None,
-                 shadow_check: bool = False):
+                 shadow_check: bool = False,
+                 trace=None):
         from ...config import getConfig
 
         self._data = data
@@ -177,6 +179,15 @@ class OrderingService:
         # == device verdict on every query (sim/test mode).
         self._vote_plane = vote_plane
         self._shadow_check = shadow_check
+        # flight recorder (observability.trace): 3PC lifecycle marks keyed
+        # (view_no, pp_seq_no, digest). NULL_TRACE when tracing is off —
+        # every record below guards arg construction on trace.enabled.
+        self._trace = trace if trace is not None else NULL_TRACE
+        # keys whose commit-quorum observation is already marked: the
+        # quorum for seq k can become visible while k-1 still blocks
+        # in-order delivery — the mark must land at OBSERVATION so the
+        # derived "order" phase measures that head-of-line wait
+        self._commit_quorum_marked: set = set()
         # tick-batched quorum evaluation (config.QuorumTickInterval > 0):
         # message handlers only RECORD votes; the runtime composition (the
         # SimPool / Node event loop) syncs the vote plane once per tick and
@@ -285,6 +296,14 @@ class OrderingService:
             # repeat cadence that survives votes lost mid-partition
             # without spamming an instance change every interval
             self._stall_snapshot = None
+            if self._trace.enabled:
+                # flight-recorder trigger: the trace tail at the moment
+                # the watchdog fired IS the stall's forensic record
+                self._trace.trigger_dump(
+                    "ordering_stall", node=self.name,
+                    args={"view_no": self._data.view_no,
+                          "last_ordered":
+                              list(self._data.last_ordered_3pc)})
             self._bus.send(VoteForViewChange(
                 suspicion=Suspicions.ORDERING_STALLED))
             return
@@ -336,6 +355,36 @@ class OrderingService:
             self._order_dirty = True
         else:
             self._try_order(key)
+            if self._trace.enabled and key not in self.ordered:
+                # per-message mode: the quorum this COMMIT may have
+                # completed is observable NOW even when in-order delivery
+                # still blocks — mark the observation, not the ordering
+                self._mark_commit_quorum_observed(key)
+
+    def _mark_commit_quorum_observed(self, key: Tuple[int, int]) -> None:
+        """Record ``3pc.commit_quorum`` ONCE per key, at the instant the
+        service first sees the quorum (trace-gated: pure observability,
+        the ordering path never depends on it)."""
+        if key in self._commit_quorum_marked:
+            return
+        pp = self.prePrepares.get(key)
+        if pp is None \
+                or preprepare_to_batch_id(pp) not in self._data.prepared:
+            return
+        if not self._has_commit_quorum(key):
+            return
+        self._commit_quorum_marked.add(key)
+        self._trace.record("3pc.commit_quorum", node=self.name,
+                           key=(pp.viewNo, pp.ppSeqNo, pp.digest))
+
+    def _probe_commit_quorums(self) -> None:
+        """Tick mode: sweep the unordered in-flight window for commit
+        quorums that became visible this tick (bounded by the watermark
+        window; snapshot reads only)."""
+        for key in sorted(self.prePrepares):
+            if key not in self.ordered \
+                    and key not in self._commit_quorum_marked:
+                self._mark_commit_quorum_observed(key)
 
     def service_quorum_tick(self) -> None:
         """Evaluate quorums for everything that moved since the last tick.
@@ -360,6 +409,13 @@ class OrderingService:
             self._order_dirty = True
             self._dirty_prepare_keys |= {
                 k for k in keys if k not in self.ordered}
+        if self._trace.enabled:
+            # commit quorums visible in this tick's snapshot for batches
+            # that can NOT order yet (a predecessor blocks in-order
+            # delivery): mark the observation now, so commit_quorum →
+            # ordered measures the head-of-line wait. Snapshot reads are
+            # free in tick mode (defer_flush_on_query).
+            self._probe_commit_quorums()
         # every batch _try_order delivered above queued its BLS aggregate
         # check (deferred mode): ONE multi-pairing proves them all
         self._bls.flush()
@@ -451,6 +507,10 @@ class OrderingService:
         if self._vote_plane is not None:
             self._vote_plane.record_preprepare(pp.ppSeqNo)
         self._network.send(pp)
+        if self._trace.enabled:
+            self._trace.record("3pc.preprepare_sent", node=self.name,
+                               key=(pp.viewNo, pp.ppSeqNo, pp.digest),
+                               args={"reqs": len(reqs)})
         logger.debug("%s sent PRE-PREPARE %s (%d reqs)", self.name, key,
                      len(reqs))
         return pp
@@ -589,6 +649,10 @@ class OrderingService:
                 if p.digest == pp.digest:
                     self._vote_plane.record_prepare(s, pp.ppSeqNo)
         self._bls.process_pre_prepare(pp, sender)
+        if self._trace.enabled:
+            self._trace.record("3pc.preprepare", node=self.name,
+                               key=(pp.viewNo, pp.ppSeqNo, pp.digest),
+                               args={"reqs": len(pp.reqIdr)})
 
         if not self._data.is_primary_in_view:
             self._send_prepare(pp)
@@ -678,6 +742,9 @@ class OrderingService:
             return
         # votes must match the accepted PRE-PREPARE digest
         self._data.prepare_batch(bid)
+        if self._trace.enabled:
+            self._trace.record("3pc.prepare_quorum", node=self.name,
+                               key=(pp.viewNo, pp.ppSeqNo, pp.digest))
         self._send_commit(pp)
 
     def _send_commit(self, pp: PrePrepare) -> None:
@@ -765,6 +832,15 @@ class OrderingService:
         pp = self.prePrepares[key]
         self.ordered.add(key)
         self._data.last_ordered_3pc = key
+        if self._trace.enabled:
+            # the quorum observation usually coincides with ordering
+            # (head-of-line batch); when it was visible EARLIER while a
+            # predecessor blocked, _mark_commit_quorum_observed already
+            # stamped it and the dedupe keeps that earlier timestamp
+            self._mark_commit_quorum_observed(key)
+            self._trace.record("3pc.ordered", node=self.name,
+                               key=(pp.viewNo, pp.ppSeqNo, pp.digest),
+                               args={"reqs": len(pp.reqIdr)})
         self._bls.process_order(key, self._data.quorums, pp)
         ordered = Ordered(
             instId=self._data.inst_id,
@@ -789,6 +865,9 @@ class OrderingService:
 
     def process_view_change_started(self, msg: ViewChangeStarted) -> None:
         """Revert uncommitted batches; retain PrePrepares for re-ordering."""
+        if self._trace.enabled:
+            self._trace.record("vc.started", cat="vc", node=self.name,
+                               args={"view_no": self._data.view_no})
         if self._is_master and self._executor is not None:
             # revert unordered speculatively-applied batches (newest first)
             unordered = [k for k in self.prePrepares
@@ -809,6 +888,7 @@ class OrderingService:
             self._vote_plane.reset(h=self._data.low_watermark)
         self._pending_old_view_bids.clear()
         self._dirty_prepare_keys.clear()
+        self._commit_quorum_marked.clear()
         self._fetch_timer.stop()
         self.sent_preprepares.clear()
         self.prePrepares.clear()
@@ -921,6 +1001,8 @@ class OrderingService:
             for key in [k for k in store if k[1] <= pp_seq_no]:
                 del store[key]
         self.ordered = {k for k in self.ordered if k[1] > pp_seq_no}
+        self._commit_quorum_marked = {
+            k for k in self._commit_quorum_marked if k[1] > pp_seq_no}
         if self._vote_plane is not None:
             self._vote_plane.reset(h=pp_seq_no)
         self._bls.gc((view_no, pp_seq_no))
@@ -937,6 +1019,8 @@ class OrderingService:
             for key in [k for k in store if k[1] <= stable_seq]:
                 del store[key]
         self.ordered = {k for k in self.ordered if k[1] > stable_seq}
+        self._commit_quorum_marked = {
+            k for k in self._commit_quorum_marked if k[1] > stable_seq}
         self.old_view_preprepares = {
             k: v for k, v in self.old_view_preprepares.items()
             if k[1] > stable_seq}

@@ -39,7 +39,8 @@ class Monitor:
                  bus: InternalBus,
                  config,
                  num_instances: int,
-                 metrics=None):
+                 metrics=None,
+                 trace=None):
         self._name = name
         self._timer = timer
         self._bus = bus
@@ -48,6 +49,9 @@ class Monitor:
         # handed in, snapshot() surfaces the device amortization numbers
         # (dispatches per tick, flush occupancy) next to the RBFT ratios
         self._metrics = metrics
+        # consensus flight recorder: snapshot() derives the per-phase
+        # 3PC latency percentiles from its lifecycle marks
+        self._trace = trace
         # digest -> finalisation timestamp (latency measurement base)
         self._finalised_at: Dict[str, float] = {}
         self._throughputs: List[WindowedThroughputMeasurement] = []
@@ -184,6 +188,16 @@ class Monitor:
                 device["shard_occupancy"] = occ_per_shard
             if device:
                 snap["device_dispatch"] = device
+        if self._trace is not None and self._trace.enabled:
+            # per-phase latency attribution (flight recorder): where this
+            # node's ordered batches spent their time — prepare / commit
+            # / order / execute (+ the pool's auth phase) as p50/p90/p99
+            from ..observability.trace import phase_percentiles
+
+            phases = phase_percentiles(self._trace.events(),
+                                       node=self._name)
+            if phases:
+                snap["phase_latency"] = phases
         return snap
 
     def master_throughput_ratio(self) -> Optional[float]:

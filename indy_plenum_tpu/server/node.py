@@ -45,6 +45,7 @@ from ..common.stashing_router import StashingRouter
 from ..common.txn_util import get_from, get_req_id
 from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config, getConfig
+from ..observability.trace import _NO_SPAN
 from ..storage.req_id_to_txn import ReqIdrToTxn
 from .client_authn import CoreAuthNr
 from .consensus.checkpoint_service import CheckpointService
@@ -132,13 +133,30 @@ class Node:
                  drive_quorum_ticks: bool = True,
                  num_instances: int = 1,
                  metrics=None,
-                 backup_vote_plane_factory=None):
+                 backup_vote_plane_factory=None,
+                 trace=None):
         self.name = name
         self.config = config or getConfig()
         self.timer = timer
         # injectable: pass a NullMetricsCollector to disable collection,
         # or a shared collector to aggregate across components
         self.metrics = metrics if metrics is not None else MetricsCollector()
+        # consensus flight recorder: a pool composition injects its
+        # shared virtual-clock recorder (deterministic traces); a
+        # standalone deployed node builds its own on perf_counter when
+        # config enables it (real durations, no determinism claim)
+        from ..observability.trace import NULL_TRACE, TraceRecorder
+
+        if trace is not None:
+            self.trace = trace
+        elif self.config.TraceRecorderEnabled:
+            import time as _time
+
+            self.trace = TraceRecorder(
+                _time.perf_counter,
+                capacity=self.config.TraceRecorderCapacity, node=name)
+        else:
+            self.trace = NULL_TRACE
         # f+1 protocol instances (RBFT): instance i's primary is offset i
         # in the round-robin; only the master (inst 0) executes
         if num_instances <= 0:
@@ -248,7 +266,7 @@ class Node:
             network=self.external_bus, stasher=self.stasher3pc,
             executor=self.executor, requests=self.requests_pool,
             config=self.config, vote_plane=vote_plane,
-            bls=self.bls_replica)
+            bls=self.bls_replica, trace=self.trace)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher3pc,
@@ -310,7 +328,7 @@ class Node:
 
         self.monitor = Monitor(name, timer, self.internal_bus, self.config,
                                num_instances=num_instances,
-                               metrics=self.metrics)
+                               metrics=self.metrics, trace=self.trace)
         # backup pools are bounded drop-oldest: a stalled backup primary
         # must read as a SLOW instance, not as unbounded node memory
         self.replicas = Replicas(
@@ -365,7 +383,7 @@ class Node:
             from ..tpu.governor import DispatchGovernor
 
             self._dispatch_governor = DispatchGovernor.from_config(
-                self.config, metrics=self.metrics)
+                self.config, metrics=self.metrics, trace=self.trace)
             interval = (self._dispatch_governor.interval
                         if self._dispatch_governor
                         else self.config.QuorumTickInterval)
@@ -412,12 +430,20 @@ class Node:
         self.replicas.teardown()
         if self._quorum_tick_timer is not None:
             self._quorum_tick_timer.stop()
+        # teardown flush: a KV-backed collector loses up to
+        # flush_every - 1 events otherwise (no-op on the plain collector)
+        self.metrics.close()
 
     def _quorum_tick(self) -> None:
         # dispatch-plane order: drain the signed-request ingress (one
         # device auth batch), scatter buffered votes (one grouped device
         # step), then evaluate quorums against the fresh snapshot
-        self._flush_auth_queue()
+        trace_on = self.trace.enabled
+        if trace_on:
+            with self.trace.span("tick.drain", node=self.name):
+                self._flush_auth_queue()
+        else:
+            self._flush_auth_queue()
         plane = self.vote_plane
         before = (plane.flushes, plane.flush_votes_total,
                   plane.flush_capacity_total)
@@ -425,17 +451,29 @@ class Node:
         dispatches = plane.flushes - before[0]
         self.metrics.add_event(MetricsName.DEVICE_DISPATCHES_PER_TICK,
                                dispatches)
+        if trace_on:
+            self.trace.record(
+                "tick.flush", cat="dispatch", node=self.name,
+                args={"dispatches": dispatches,
+                      "votes": plane.flush_votes_total - before[1]})
         if self._dispatch_governor is not None:
             self._quorum_tick_timer.update_interval(
                 self._dispatch_governor.observe(
                     plane.flush_votes_total - before[1],
                     plane.flush_capacity_total - before[2], dispatches))
-        self.ordering.service_quorum_tick()
-        self.checkpoints.service_quorum_tick()
-        for backup in self.replicas.backups:
-            if backup.vote_plane is not None:
-                backup.ordering.service_quorum_tick()
-                backup.checkpoints.service_quorum_tick()
+            if trace_on:
+                self.trace.record(
+                    "tick.governor", cat="dispatch", node=self.name,
+                    args={"interval": round(
+                        self._dispatch_governor.interval, 9)})
+        with self.trace.span("tick.eval", node=self.name,
+                             args={"nodes": 1}) if trace_on else _NO_SPAN:
+            self.ordering.service_quorum_tick()
+            self.checkpoints.service_quorum_tick()
+            for backup in self.replicas.backups:
+                if backup.vote_plane is not None:
+                    backup.ordering.service_quorum_tick()
+                    backup.checkpoints.service_quorum_tick()
 
     # ------------------------------------------------------------------
     # client ingress
@@ -504,6 +542,9 @@ class Node:
             return False
         if client_id is not None:
             self._req_clients[req.digest] = client_id
+        if self.trace.enabled:
+            self.trace.record("req.ingress", cat="req", node=self.name,
+                              key=(req.digest,))
         self._auth_queue.append(req)
         return True
 
@@ -612,6 +653,9 @@ class Node:
         self.requests_pool.enqueue(request)
         self.ordering.on_request_finalised()
         self.monitor.request_finalised(request.digest)
+        if self.trace.enabled:
+            self.trace.record("req.finalised", cat="req", node=self.name,
+                              key=(request.digest,))
         self.replicas.enqueue_finalised(request)
 
     def _on_backup_ordered(self, inst_id: int, ordered: Ordered) -> None:
@@ -688,6 +732,10 @@ class Node:
                                len(ordered.reqIdr))
         with self.metrics.measure_time(MetricsName.COMMIT_TIME):
             staged = self.executor.commit_batch(ordered.ppSeqNo)
+        if self.trace.enabled:
+            self.trace.record(
+                "3pc.executed", node=self.name,
+                key=(ordered.viewNo, ordered.ppSeqNo, ordered.digest))
         if staged is None:
             return
         ledger = self.boot.db.get_ledger(staged.ledger_id)

@@ -31,7 +31,8 @@ class NodePool:
                  bls: bool = False,
                  num_instances: int = 1,
                  with_pool_genesis: bool = False,
-                 mesh=None):
+                 mesh=None,
+                 trace: bool = False):
         # num_instances: 1 = master only; 0 = auto f+1 (full RBFT)
         # mesh: shard the grouped vote plane's (node x instance) member
         # axis across a jax device mesh (CPU CI provisions virtual
@@ -41,6 +42,14 @@ class NodePool:
              "PropagateBatchWait": 0.05})
         self.timer = MockTimer(start_time=1_700_000_000.0)
         self.metrics = MetricsCollector()
+        # pool-shared flight recorder on the virtual clock (deterministic
+        # dumps); every Node's services + Monitor share it
+        from ..observability.trace import NULL_TRACE, TraceRecorder
+
+        self.trace = (TraceRecorder(
+            self.timer.get_current_time,
+            capacity=self.config.TraceRecorderCapacity)
+            if trace else NULL_TRACE)
         self.network = SimNetwork(self.timer, seed=seed,
                                   metrics=self.metrics)
         self.validators = [f"node{i}" for i in range(n_nodes)]
@@ -95,6 +104,7 @@ class NodePool:
                 n_nodes, self.validators, self.config,
                 num_instances=resolved_instances, mesh=mesh,
                 metrics=self.metrics)
+            self.vote_group.trace = self.trace
 
         tick_mode = self.config.QuorumTickInterval > 0
 
@@ -126,7 +136,8 @@ class NodePool:
                 # tick records are then visible in every node's
                 # Monitor.snapshot() (and node metrics aggregate pool-wide)
                 metrics=self.metrics,
-                backup_vote_plane_factory=backup_plane_factory(i))
+                backup_vote_plane_factory=backup_plane_factory(i),
+                trace=self.trace)
             self.nodes.append(node)
         self.network.connect_all()
         for node in self.nodes:
@@ -142,7 +153,7 @@ class NodePool:
 
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,
-            ingress=drain_auth_queues)
+            ingress=drain_auth_queues, trace=self.trace)
         self.governor = getattr(self._quorum_tick_timer, "governor", None)
 
         self._req_seq = 0

@@ -146,7 +146,7 @@ class SimNode:
                  domain_genesis: Optional[list] = None,
                  storage=None, bls_keys=None,
                  shadow_check: Optional[bool] = None,
-                 vote_plane=None):
+                 vote_plane=None, trace=None):
         # shadow_check default: on whenever the device plane decides, so
         # tests continuously prove host/device equivalence. The bench turns
         # it off to run the device plane as the SOLE quorum authority.
@@ -162,6 +162,11 @@ class SimNode:
                 " tallies")
         self.name = name
         self.config = config
+        from ..observability.trace import NULL_TRACE
+
+        # pool-shared flight recorder (virtual-clock timestamps): the
+        # executed mark below completes each batch's 3PC lifecycle
+        self.trace = trace if trace is not None else NULL_TRACE
         self.data = ConsensusSharedData(
             name, validators, inst_id=0, is_master=True,
             log_size=config.LOG_SIZE)
@@ -240,7 +245,8 @@ class SimNode:
             network=self.external_bus, stasher=self.stasher3pc,
             executor=self.executor, requests=self.requests_view,
             config=config, vote_plane=self.vote_plane,
-            shadow_check=shadow_check, bls=self.bls_replica)
+            shadow_check=shadow_check, bls=self.bls_replica,
+            trace=self.trace)
         self.checkpoints = CheckpointService(
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher3pc,
@@ -301,6 +307,10 @@ class SimNode:
         self.executed_upto = ordered.ppSeqNo
         self.ordered_log.append(ordered)
         self.executor.commit_batch(ordered.ppSeqNo)
+        if self.trace.enabled:
+            self.trace.record(
+                "3pc.executed", node=self.name,
+                key=(ordered.viewNo, ordered.ppSeqNo, ordered.digest))
 
     def _on_catchup_finished(self, msg, *args) -> None:
         # batches at/below the caught-up point were executed THROUGH the
@@ -347,11 +357,22 @@ class SimPool:
                  mesh=None,
                  host_accounting: bool = False,
                  pipelined_flush: bool = False,
-                 spy: bool = False):
+                 spy: bool = False,
+                 trace: bool = False,
+                 trace_capacity: Optional[int] = None):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.timer = MockTimer(start_time=1_700_000_000.0)
         self.metrics = MetricsCollector()
+        # consensus flight recorder: one pool-shared ring on the VIRTUAL
+        # clock, so a seeded run (chaos and mesh runs included) dumps a
+        # bit-identical trace — checkable like ordered_hash()
+        from ..observability.trace import NULL_TRACE, TraceRecorder
+
+        self.trace = (TraceRecorder(
+            self.timer.get_current_time,
+            capacity=trace_capacity or self.config.TraceRecorderCapacity)
+            if trace else NULL_TRACE)
         self.network = SimNetwork(self.timer, seed=seed,
                                   metrics=self.metrics)
         self.validators = [f"node{i}" for i in range(n_nodes)]
@@ -410,6 +431,7 @@ class SimPool:
                 n_nodes, self.validators, self.config,
                 num_instances=num_instances, mesh=mesh,
                 pipelined=pipelined_flush, metrics=self.metrics)
+            self.vote_group.trace = self.trace
 
         k = num_instances
         self.nodes: List[SimNode] = [
@@ -418,7 +440,8 @@ class SimPool:
                     domain_genesis=domain_genesis if real_execution else None,
                     bls_keys=self.bls_keys, shadow_check=shadow_check,
                     vote_plane=(self.vote_group.view(i * k)
-                                if self.vote_group else None))
+                                if self.vote_group else None),
+                    trace=self.trace)
             for i, name in enumerate(self.validators)]
         self.network.connect_all()
 
@@ -499,7 +522,8 @@ class SimPool:
             self.timer, self.config, self.vote_group, self.nodes,
             accounting=self.host_seconds,
             ingress=(self.flush_ingress if self.authnr is not None
-                     else None))
+                     else None),
+            trace=self.trace)
         # adaptive tick mode: the governor's interval trajectory is a
         # first-class observable (bench digests, determinism tests)
         self.governor = getattr(self._quorum_tick_timer, "governor", None)
@@ -564,11 +588,16 @@ class SimPool:
         else:
             req = Request(identifier="client1", reqId=seq,
                           operation={"type": "1", "v": seq})
+        if self.trace.enabled:
+            self.trace.record("req.ingress", cat="req", key=(req.digest,))
         if self.sign_requests:
             self.trustee.sign_request(req)
             self._ingress.append(req)
         else:
             self.requests.add_finalised(req)
+            if self.trace.enabled:
+                self.trace.record("req.finalised", cat="req",
+                                  key=(req.digest,))
         return req
 
     def submit_tampered_request(self, seq: int) -> Request:
@@ -592,9 +621,18 @@ class SimPool:
         self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
         with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
             verdicts = self.authnr.authenticate_batch(batch)
+        trace_on = self.trace.enabled
+        if trace_on:
+            self.trace.record("tick.auth", cat="dispatch",
+                              args={"batch": len(batch),
+                                    "ok": int(sum(bool(v)
+                                                  for v in verdicts))})
         for req, ok in zip(batch, verdicts):
             if ok:
                 self.requests.add_finalised(req)
+                if trace_on:
+                    self.trace.record("req.finalised", cat="req",
+                                      key=(req.digest,))
         return list(verdicts)
 
     def run_for(self, seconds: float) -> None:

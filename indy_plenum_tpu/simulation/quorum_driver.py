@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from ..common.metrics_collector import MetricsName
 from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config
+from ..observability.trace import _NO_SPAN
 
 
 def make_vote_group(n_nodes: int, validators, config: Config,
@@ -55,8 +56,8 @@ def make_vote_group(n_nodes: int, validators, config: Config,
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                       nodes, accounting=None,
-                      ingress: Optional[Callable[[], None]] = None
-                      ) -> Optional[RepeatingTimer]:
+                      ingress: Optional[Callable[[], None]] = None,
+                      trace=None) -> Optional[RepeatingTimer]:
     """Start the pool-level quorum tick (tick-batched mode only).
 
     Each node must expose ``vote_plane`` / ``ordering`` / ``checkpoints``;
@@ -86,10 +87,16 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
 
     from time import perf_counter
 
+    from ..observability.trace import NULL_TRACE
     from ..tpu.governor import DispatchGovernor
 
+    # flight recorder: per-tick dispatch-plane spans (drain / flush /
+    # eval / governor decision) join the 3PC lifecycle marks the
+    # services record — one attributable timeline per tick
+    trace = trace if trace is not None else NULL_TRACE
     governor = DispatchGovernor.from_config(config,
-                                            metrics=vote_group.metrics)
+                                            metrics=vote_group.metrics,
+                                            trace=trace)
     last = [vote_group.flushes, vote_group.flush_votes_total,
             vote_group.flush_capacity_total]
     # per-shard baselines (length 1 when unsharded): the governor's law
@@ -104,12 +111,21 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
         # ingress is a pool-level stand-in — charging its auth batch to
         # every node's host_seconds would n-fold over-count it
         if ingress is not None:
-            ingress()
+            if trace.enabled:
+                with trace.span("tick.drain"):
+                    ingress()
+            else:
+                ingress()
         t0 = perf_counter() if accounting is not None else 0.0
         vote_group.flush()
         dispatches = vote_group.flushes - last[0]
         vote_group.metrics.add_event(
             MetricsName.DEVICE_DISPATCHES_PER_TICK, dispatches)
+        if trace.enabled:
+            trace.record("tick.flush", cat="dispatch",
+                         args={"dispatches": dispatches,
+                               "votes": vote_group.flush_votes_total
+                               - last[1]})
         if governor is not None:
             new_interval = governor.observe_shards(
                 [a - b for a, b in zip(vote_group.flush_votes_per_shard,
@@ -118,22 +134,29 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                                        last_shard[1])],
                 dispatches)
             timer_box[0].update_interval(new_interval)
+            if trace.enabled:
+                trace.record(
+                    "tick.governor", cat="dispatch",
+                    args={"interval": round(new_interval, 9),
+                          "occupancy_ewma": round(governor.ewma, 6)})
         last[:] = [vote_group.flushes, vote_group.flush_votes_total,
                    vote_group.flush_capacity_total]
         last_shard[0] = list(vote_group.flush_votes_per_shard)
         last_shard[1] = list(vote_group.flush_capacity_per_shard)
         flush_dt = perf_counter() - t0 if accounting is not None else 0.0
-        for node in nodes:
-            t0 = perf_counter() if accounting is not None else 0.0
-            node.ordering.service_quorum_tick()
-            node.checkpoints.service_quorum_tick()
-            replicas = getattr(node, "replicas", None)  # SimNode has none
-            for backup in (replicas.backups if replicas else ()):
-                if backup.vote_plane is not None:
-                    backup.ordering.service_quorum_tick()
-                    backup.checkpoints.service_quorum_tick()
-            if accounting is not None:
-                accounting[node.name] += (perf_counter() - t0) + flush_dt
+        with trace.span("tick.eval", args={"nodes": len(nodes)}) \
+                if trace.enabled else _NO_SPAN:
+            for node in nodes:
+                t0 = perf_counter() if accounting is not None else 0.0
+                node.ordering.service_quorum_tick()
+                node.checkpoints.service_quorum_tick()
+                replicas = getattr(node, "replicas", None)  # SimNode: none
+                for backup in (replicas.backups if replicas else ()):
+                    if backup.vote_plane is not None:
+                        backup.ordering.service_quorum_tick()
+                        backup.checkpoints.service_quorum_tick()
+                if accounting is not None:
+                    accounting[node.name] += (perf_counter() - t0) + flush_dt
 
     interval = governor.interval if governor else config.QuorumTickInterval
     rt = RepeatingTimer(timer, interval, tick, barrier=True)

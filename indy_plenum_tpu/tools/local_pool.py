@@ -139,10 +139,20 @@ def build_node(directory: str, name: str, looper: Looper,
          "PropagateBatchWait": 0.05})
     node_seed = load_secret_seed(directory, name)
     # ONE collector per validator, shared by transport and node: HWM drops
-    # (zstack.dropped) land in the same summary as auth/commit timings
-    from ..common.metrics_collector import MetricsCollector
+    # (zstack.dropped) land in the same summary as auth/commit timings.
+    # The default "kv" type persists snapshots (stats + histograms) under
+    # the node directory so a restarted validator keeps its history —
+    # Node.stop() closes it, flushing the final partial window.
+    if config.METRICS_COLLECTOR_TYPE == "kv":
+        from ..common.metrics_collector import KvMetricsCollector
+        from ..storage.kv_store import initKeyValueStorage
 
-    metrics = MetricsCollector()
+        metrics = KvMetricsCollector(initKeyValueStorage(
+            config.KVStorageType, directory, f"metrics_{name}"))
+    else:
+        from ..common.metrics_collector import MetricsCollector
+
+        metrics = MetricsCollector()
     stack = ZStack(name, node_seed,
                    bind_host=record["node_ip"],
                    bind_port=record["node_port"],

@@ -40,12 +40,19 @@ from collections import deque
 from typing import Optional
 
 from ..common.metrics_collector import MetricsCollector, MetricsName
+from ..observability.trace import NULL_TRACE
 
 # retained trajectory window: full fidelity for any bench/test-sized run,
 # bounded for a deployed node governing ticks for days (at the default
 # floor of base/4 this is hours of history; the running min/max and the
 # metrics stat/histogram keep whole-run aggregates exact)
 TRAJECTORY_WINDOW = 65536
+
+# governor anomaly (flight-recorder trigger): the law has pinned the
+# interval at its floor for this many consecutive ticks while the
+# saturation signal persists — the controller can no longer relieve the
+# load, which is exactly the moment a trace tail is worth keeping
+ANOMALY_SATURATED_TICKS = 8
 
 
 class DispatchGovernor:
@@ -55,7 +62,8 @@ class DispatchGovernor:
                  max_interval: float, alpha: float = 0.3,
                  occupancy_low: float = 0.02, occupancy_high: float = 0.85,
                  widen: float = 1.5, narrow: float = 0.5,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 trace=None):
         if not (0.0 < min_interval <= max_interval):
             raise ValueError(
                 f"bad governor bounds [{min_interval}, {max_interval}]")
@@ -85,6 +93,10 @@ class DispatchGovernor:
         self._interval_low: Optional[float] = None
         self._interval_high: Optional[float] = None
         self.metrics = metrics if metrics is not None else MetricsCollector()
+        # flight recorder: saturation anomalies dump the trace tail
+        self.trace = trace if trace is not None else NULL_TRACE
+        self._saturated_ticks = 0
+        self.anomalies = 0
 
     # ------------------------------------------------------------------
 
@@ -116,12 +128,29 @@ class DispatchGovernor:
                 self.alpha * occ + (1.0 - self.alpha) * ewma
                 for occ, ewma in zip(occs, self.shard_ewmas)]
         self.ewma = max(self.shard_ewmas)
-        if dispatches > 1 or self.ewma >= self.occupancy_high:
+        saturated = dispatches > 1 or self.ewma >= self.occupancy_high
+        if saturated:
             self.interval = max(self.interval * self.narrow,
                                 self.min_interval)
         elif self.ewma <= self.occupancy_low:
             self.interval = min(self.interval * self.widen,
                                 self.max_interval)
+        # anomaly: pinned at the floor AND still saturated — narrowing
+        # can't relieve the load anymore. Fires ONCE per episode (the
+        # counter only rearms after a non-saturated tick), deterministic
+        # like the rest of the law.
+        if saturated and self.interval <= self.min_interval:
+            self._saturated_ticks += 1
+            if self._saturated_ticks == ANOMALY_SATURATED_TICKS:
+                self.anomalies += 1
+                if self.trace.enabled:
+                    self.trace.trigger_dump(
+                        "governor_saturated",
+                        args={"ewma": round(self.ewma, 6),
+                              "interval": self.interval,
+                              "ticks": self.ticks})
+        else:
+            self._saturated_ticks = 0
         self.ticks += 1
         self.trajectory.append(self.interval)
         if self._interval_low is None or self.interval < self._interval_low:
@@ -163,6 +192,7 @@ class DispatchGovernor:
             "interval_max": round(self._interval_high, 6),
             "occupancy_ewma": (round(self.ewma, 6)
                                if self.ewma is not None else None),
+            "anomalies": self.anomalies,
         }
         if self.shard_ewmas is not None and len(self.shard_ewmas) > 1:
             out["shards"] = len(self.shard_ewmas)
@@ -171,8 +201,8 @@ class DispatchGovernor:
         return out
 
     @classmethod
-    def from_config(cls, config, metrics: Optional[MetricsCollector] = None
-                    ) -> Optional["DispatchGovernor"]:
+    def from_config(cls, config, metrics: Optional[MetricsCollector] = None,
+                    trace=None) -> Optional["DispatchGovernor"]:
         """The single wiring point for every tick driver (quorum_driver,
         Node._quorum_tick): None unless tick-batched AND adaptive."""
         if config.QuorumTickInterval <= 0 or not config.QuorumTickAdaptive:
@@ -184,4 +214,4 @@ class DispatchGovernor:
                    occupancy_high=config.GovernorOccupancyHigh,
                    widen=config.GovernorWiden,
                    narrow=config.GovernorNarrow,
-                   metrics=metrics)
+                   metrics=metrics, trace=trace)

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common.metrics_collector import MetricsCollector, MetricsName
+from ..observability.trace import NULL_TRACE, _NO_SPAN
 from . import quorum as q
 
 # fixed flush granularity: stable shapes keep XLA from recompiling
@@ -579,6 +580,11 @@ class VotePlaneGroup:
         # latency and votes-per-flush land here (injectable for a shared
         # or null collector)
         self.metrics = metrics if metrics is not None else MetricsCollector()
+        # flight recorder (observability.trace): per-dispatch spans
+        # (flush.dispatch with votes/shape, flush.readback) land here
+        # when the composition roots hand in a recorder; NULL_TRACE
+        # keeps the hot path free otherwise
+        self.trace = NULL_TRACE
         # pipelined mode: flush() DISPATCHES this tick's step (async, JAX
         # never blocks on dispatch) and absorbs the PREVIOUS tick's events
         # into the host snapshot — the device round-trip overlaps a full
@@ -607,10 +613,12 @@ class VotePlaneGroup:
 
     def _absorb(self, events: q.QuorumEvents) -> None:
         """ONE bundled device->host transfer into the host snapshot."""
-        (self._host_prepared, self._host_prepare_counts,
-         self._host_commit_counts, self._host_stable) = jax.device_get(
-            (events.prepared, events.prepare_counts,
-             events.commit_counts, events.stable_checkpoints))
+        with self.trace.span("flush.readback") if self.trace.enabled \
+                else _NO_SPAN:
+            (self._host_prepared, self._host_prepare_counts,
+             self._host_commit_counts, self._host_stable) = jax.device_get(
+                (events.prepared, events.prepare_counts,
+                 events.commit_counts, events.stable_checkpoints))
         self.version += 1
 
     @property
@@ -692,8 +700,12 @@ class VotePlaneGroup:
                 shape = self._ladder.shape(busiest)
             else:
                 shape = ladder_shape(busiest)
-            words = self._stage_scatter(chunks, shape)
-            self._states, events = self._run_group_step(words)
+            with self.trace.span(
+                    "flush.dispatch",
+                    args={"votes": votes, "shape": shape}) \
+                    if self.trace.enabled else _NO_SPAN:
+                words = self._stage_scatter(chunks, shape)
+                self._states, events = self._run_group_step(words)
             self.flushes += 1
             capacity = len(self._members) * shape
             self.flush_votes_total += votes

@@ -57,6 +57,14 @@ def main() -> int:
                              "devices (requires --device-quorum; on CPU "
                              "the host platform self-provisions virtual "
                              "devices)")
+    parser.add_argument("--trace", action="store_true",
+                        help="arm the consensus flight recorder: the "
+                             "report gains trace_hash + flight_recorder "
+                             "tail dumps and the full span trace lands "
+                             "next to the report as <out>.trace.jsonl "
+                             "(consume with scripts/trace_tool.py); "
+                             "deterministic — replaying the same seed "
+                             "reproduces the dump bit-for-bit")
     args = parser.parse_args()
     if args.tick > 0 and not args.device_quorum:
         parser.error("--tick requires --device-quorum")
@@ -97,7 +105,10 @@ def main() -> int:
                           device_quorum=args.device_quorum,
                           quorum_tick_interval=args.tick,
                           quorum_tick_adaptive=args.adaptive_tick,
-                          mesh=mesh)
+                          mesh=mesh,
+                          trace=args.trace,
+                          trace_out=(out + ".trace.jsonl"
+                                     if args.trace else None))
     for line in report.summary_lines():
         print(line)
     print(f"  report: {out}")

@@ -27,6 +27,14 @@ choice, never a semantics change), if the mesh run's
 ``--sharded-tolerance`` of the 1-device run, or if its flush occupancy
 falls below the floor.
 
+Tracing gate (PR 5): unless ``--no-trace-gate``, the script runs the
+n=16/k=6 workload twice on the SAME seed — flight recorder disabled vs
+enabled — and fails if the ordered digests diverge (observability must
+never perturb consensus) or the traced run's ordered/sim-second falls
+more than ``--trace-tolerance`` below the untraced run. The wall-clock
+ratio is recorded alongside, so the recorder can never silently tax the
+hot path.
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
@@ -90,7 +98,7 @@ def _submit_bursty(pool, target: int) -> None:
 
 def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
             tick_interval: float, seed: int = 11, adaptive: bool = False,
-            bursty: bool = False, mesh=None) -> dict:
+            bursty: bool = False, mesh=None, trace: bool = False) -> dict:
     """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
     measurement: the gate counts every dispatch from pool construction on
     (cold-start/compile steps included), because the budget protects the
@@ -104,13 +112,14 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     })
     pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
                    device_quorum=True, shadow_check=False,
-                   num_instances=instances, mesh=mesh)
+                   num_instances=instances, mesh=mesh, trace=trace)
 
     def min_ordered():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
 
     target = batches * batch_size
     sim_t0 = pool.timer.get_current_time()
+    wall_t0 = time.perf_counter()
     if bursty:
         _submit_bursty(pool, target)
     else:
@@ -122,6 +131,7 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     assert min_ordered() >= target, f"stalled at {min_ordered()}/{target}"
     assert pool.honest_nodes_agree()
     sim_elapsed = pool.timer.get_current_time() - sim_t0
+    wall_elapsed = time.perf_counter() - wall_t0
 
     dispatches = pool.vote_group.flushes
     delivered = pool.network.sent
@@ -144,6 +154,7 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         "dispatches_per_tick_max": per_tick.max if per_tick else None,
         "ordered_per_sim_second": round(target / sim_elapsed, 2)
         if sim_elapsed else None,
+        "wall_s": round(wall_elapsed, 2),
         # agreement is asserted above, so one node's ordered-digest hash
         # identifies the whole pool's ordering (the sharded gate compares
         # it against the 1-device run)
@@ -154,6 +165,9 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         result["shard_occupancy"] = pool.vote_group.shard_occupancy
     if pool.governor is not None:
         result["governor"] = pool.governor.trajectory_summary()
+    if trace:
+        result["trace_events"] = len(pool.trace)
+        result["trace_hash"] = pool.trace.trace_hash()
     return result
 
 
@@ -240,6 +254,43 @@ def sharded_gates(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def tracing_gate(args, base: "dict | None" = None) -> "tuple[dict, list]":
+    """Flight recorder disabled vs enabled on the SAME n=16/k=6 workload
+    and seed; returns (record, failures). Observability must be free in
+    protocol time (identical digests, ordered/sim-second within
+    ``--trace-tolerance``) — the wall ratio is recorded so host-side
+    drift is visible even when the gate passes. ``base`` reuses the
+    sharded gate's single-device run (identical arguments) instead of
+    paying the cold n=16/k=6 simulation a third time."""
+    if base is None:
+        base = measure(args.sharded_nodes, args.sharded_instances,
+                       args.batches, args.batch_size, args.tick,
+                       seed=args.seed)
+    traced = measure(args.sharded_nodes, args.sharded_instances,
+                     args.batches, args.batch_size, args.tick,
+                     seed=args.seed, trace=True)
+    tol = args.trace_tolerance
+    failures = []
+    if traced["ordered_hash"] != base["ordered_hash"]:
+        failures.append("traced ordered digests diverge from the "
+                        "untraced run (recording perturbed consensus)")
+    b_tps = base["ordered_per_sim_second"] or 0.0
+    t_tps = traced["ordered_per_sim_second"] or 0.0
+    if t_tps < b_tps * (1.0 - tol):
+        failures.append(f"traced ordered/sim-sec {t_tps} regresses "
+                        f"untraced {b_tps} beyond {tol:.0%}")
+    record = {
+        "untraced": base,
+        "traced": traced,
+        "trace_tolerance": tol,
+        "digests_match": traced["ordered_hash"] == base["ordered_hash"],
+        "sim_throughput_ratio": round(t_tps / b_tps, 4) if b_tps else None,
+        "wall_ratio": (round(traced["wall_s"] / base["wall_s"], 3)
+                       if base["wall_s"] else None),
+    }
+    return record, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -256,6 +307,11 @@ def main() -> int:
                     help="skip the bursty static-vs-adaptive comparison")
     ap.add_argument("--no-sharded-gate", action="store_true",
                     help="skip the 1-device vs mesh-sharded comparison")
+    ap.add_argument("--no-trace-gate", action="store_true",
+                    help="skip the flight-recorder overhead comparison")
+    ap.add_argument("--trace-tolerance", type=float, default=0.05,
+                    help="max fractional ordered/sim-second regression "
+                         "the recorder-enabled run may show vs disabled")
     ap.add_argument("--mesh-devices", type=int, default=4,
                     help="host mesh width for the sharded gate (the "
                          "script provisions virtual CPU devices via "
@@ -295,9 +351,16 @@ def main() -> int:
         record, failures = governor_gates(args)
         result["governor_gate"] = record
         over.extend(failures)
+    sharded_single = None
     if not args.no_sharded_gate:
         record, failures = sharded_gates(args)
         result["sharded_gate"] = record
+        over.extend(failures)
+        # same args as the tracing gate's untraced baseline — reuse it
+        sharded_single = record.get("single_device")
+    if not args.no_trace_gate:
+        record, failures = tracing_gate(args, base=sharded_single)
+        result["tracing_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

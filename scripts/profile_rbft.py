@@ -10,7 +10,10 @@ tick-batched run and, unless ``--no-baseline``, the same measured on a
 short per-message run (``QuorumTickInterval=0``) with the resulting
 ``amortization_factor``. ``--mesh N`` shards the grouped vote plane over
 N host devices (mesh-sharded dispatch plane); the record then carries
-``shards`` and per-shard occupancy. The determinism cross-check
+``shards`` and per-shard occupancy. ``--trace`` arms the consensus
+flight recorder: the span trace dumps to ``--trace-out`` (JSONL for
+``scripts/trace_tool.py``) and the ``--json`` record gains
+``phase_latency`` percentiles + ``critical_path``. The determinism cross-check
 (``ordered_digests`` identical between the two modes) lives in
 ``tests/test_dispatch_plane.py``; the budget gate in
 ``scripts/check_dispatch_budget.py``.
@@ -50,7 +53,8 @@ from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
 BATCH = 160
 
 
-def _build_pool(n, k, tick_interval, adaptive=False, mesh=None):
+def _build_pool(n, k, tick_interval, adaptive=False, mesh=None,
+                trace=False):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
@@ -58,7 +62,8 @@ def _build_pool(n, k, tick_interval, adaptive=False, mesh=None):
         "QuorumTickAdaptive": adaptive,
     })
     return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
-                   shadow_check=False, num_instances=k, mesh=mesh)
+                   shadow_check=False, num_instances=k, mesh=mesh,
+                   trace=trace)
 
 
 def _run(pool, txns, profile=False):
@@ -131,6 +136,14 @@ def main():
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the grouped vote plane over this many "
                          "host devices (0 = unsharded)")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm the consensus flight recorder: dumps the "
+                         "span trace as JSONL (--trace-out) and the "
+                         "--json record gains phase_latency percentiles "
+                         "+ critical_path attribution")
+    ap.add_argument("--trace-out", default="profile_rbft.trace.jsonl",
+                    help="trace dump path for --trace (consume with "
+                         "scripts/trace_tool.py)")
     args = ap.parse_args()
     n, k, txns = args.n_nodes, args.instances, args.txns
 
@@ -145,13 +158,37 @@ def main():
         mesh = Mesh(np.array(devices[:args.mesh]), ("members",))
 
     pool = _build_pool(n, k, tick_interval=0.1,
-                       adaptive=not args.static_tick, mesh=mesh)
+                       adaptive=not args.static_tick, mesh=mesh,
+                       trace=args.trace)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
     stats = pstats.Stats(prof, stream=sys.stderr)
     stats.sort_stats("cumulative").print_stats(35)
     stats.sort_stats("tottime").print_stats(35)
+
+    trace_block = None
+    if args.trace:
+        from indy_plenum_tpu.observability.trace import (
+            critical_path,
+            phase_percentiles,
+        )
+
+        events = pool.trace.events()
+        pool.trace.dump(args.trace_out)
+        trace_block = {
+            "trace_file": args.trace_out,
+            "trace_hash": pool.trace.trace_hash(),
+            "trace_events": len(events),
+            # virtual-time attribution: where the protocol pipeline
+            # spends its latency, per phase (trace_tool.py renders the
+            # same numbers from the dump)
+            "phase_latency": phase_percentiles(events),
+            "critical_path": critical_path(events),
+        }
+        print(f"trace: {args.trace_out} "
+              f"({trace_block['trace_events']} events, "
+              f"hash {trace_block['trace_hash'][:16]}…)", file=sys.stderr)
 
     if not args.json:
         return
@@ -187,6 +224,8 @@ def main():
                      if pool.governor is not None else None),
         "hotspots_top20_cumulative": _hotspots(prof),
     }
+    if trace_block is not None:
+        record.update(trace_block)
     if not args.no_baseline:
         # per-message baseline: same pool shape, QuorumTickInterval=0 —
         # every quorum query flushes. One post-warm-up batch is enough;

@@ -1,0 +1,125 @@
+"""Consume consensus flight-recorder dumps (observability.trace JSONL).
+
+Usage:
+    python scripts/trace_tool.py TRACE.jsonl                 # full report
+    python scripts/trace_tool.py TRACE.jsonl --phases        # percentiles
+    python scripts/trace_tool.py TRACE.jsonl --critical-path
+    python scripts/trace_tool.py TRACE.jsonl --chrome OUT.json
+    python scripts/trace_tool.py TRACE.jsonl --json
+    python scripts/trace_tool.py TRACE.jsonl --node node0
+
+Dumps come from ``SimPool(trace=True)`` / ``NodePool(trace=True)``,
+``chaos_run.py --trace`` (``<report>.trace.jsonl``), or
+``profile_rbft.py --trace``. Three views:
+
+- **--phases**: per-phase latency percentiles (p50/p90/p99/max) for the
+  3PC lifecycle — prepare / commit / order / execute, plus the ingress
+  auth phase. Simulation dumps measure VIRTUAL (protocol) time; deployed
+  dumps measure perf_counter time.
+- **--critical-path**: per ordered batch, which phase dominated its
+  latency, plus each phase's share of total attributed time — the view
+  that turns "a batch ordered in X ms" into "X went to the prepare wave".
+- **--chrome**: Chrome trace-event JSON (one pid per node, one tid per
+  category), loadable in Perfetto (https://ui.perfetto.dev) or
+  chrome://tracing.
+
+Deliberately free of jax imports: the tool must run anywhere a dump
+lands, including hosts without the accelerator stack.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from indy_plenum_tpu.observability.trace import (  # noqa: E402
+    critical_path,
+    load_jsonl,
+    phase_percentiles,
+    to_chrome_trace,
+)
+
+
+def _counts(events) -> dict:
+    by_cat, by_name = {}, {}
+    for ev in events:
+        by_cat[ev.get("cat", "")] = by_cat.get(ev.get("cat", ""), 0) + 1
+        by_name[ev["name"]] = by_name.get(ev["name"], 0) + 1
+    return {"events": len(events), "by_cat": by_cat, "by_name": by_name}
+
+
+def _flight_events(events) -> list:
+    return [ev for ev in events if ev.get("cat") == "flight"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dump", help="trace JSONL file")
+    ap.add_argument("--phases", action="store_true",
+                    help="per-phase latency percentiles only")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="per-batch dominant-phase breakdown only")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="write Chrome trace-event JSON (Perfetto)")
+    ap.add_argument("--node", default=None,
+                    help="restrict phase views to one node's marks")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line on stdout")
+    args = ap.parse_args()
+
+    events = load_jsonl(args.dump)
+    if not events:
+        print(f"{args.dump}: no events", file=sys.stderr)
+        return 2
+
+    record = {"dump": args.dump, "summary": _counts(events)}
+    # --phases/--critical-path narrow the view; --chrome is orthogonal
+    view_selected = args.phases or args.critical_path
+    if args.phases or not view_selected:
+        record["phase_latency"] = phase_percentiles(events, node=args.node)
+    if args.critical_path or not view_selected:
+        record["critical_path"] = critical_path(events, node=args.node)
+    if not view_selected:
+        record["flight_events"] = _flight_events(events)
+    if args.chrome:
+        chrome = to_chrome_trace(events)
+        with open(args.chrome, "w") as fh:
+            json.dump(chrome, fh, separators=(",", ":"))
+        record["chrome"] = {"file": args.chrome,
+                            "events": len(chrome["traceEvents"])}
+
+    if args.json:
+        print(json.dumps(record, separators=(",", ":"), sort_keys=True))
+        return 0
+
+    summary = record["summary"]
+    print(f"{args.dump}: {summary['events']} events "
+          f"({', '.join(f'{c}={n}' for c, n in sorted(summary['by_cat'].items()))})")
+    if "phase_latency" in record:
+        print("phase latency (p50/p90/p99/max, trace clock units):")
+        for phase, st in record["phase_latency"].items():
+            print(f"  {phase:10s} n={st['count']:<6d} p50={st['p50']:<10g}"
+                  f" p90={st['p90']:<10g} p99={st['p99']:<10g}"
+                  f" max={st['max']:g}")
+    if "critical_path" in record:
+        cp = record["critical_path"]
+        print(f"critical path over {cp['batches']} batches:")
+        for phase, cnt in cp["dominant"].items():
+            share = cp["phase_share"].get(phase, 0.0)
+            print(f"  {phase:10s} dominated {cnt} batches "
+                  f"(share of attributed time: {share:.1%})")
+    if record.get("flight_events"):
+        print("flight events:")
+        for ev in record["flight_events"]:
+            print(f"  t={ev['ts']:.3f} {ev['name']} "
+                  f"{ev.get('args') or ''}")
+    if args.chrome:
+        print(f"chrome trace: {args.chrome} "
+              f"({record['chrome']['events']} events) — load in Perfetto")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,3 +140,73 @@ def test_metrics_last_and_bounded_histogram():
     null = NullMetricsCollector()
     null.add_to_histogram("h", 1)
     assert null.histogram("h") is None
+
+
+def test_measure_time_exception_lands_in_error_series():
+    """Satellite: a raising body must NOT pollute the hot-path series —
+    its timing lands under <name>.error instead."""
+    import pytest
+
+    m = MetricsCollector()
+    with pytest.raises(ValueError):
+        with m.measure_time("op"):
+            raise ValueError("boom")
+    assert m.stat("op") is None
+    err = m.stat("op.error")
+    assert err is not None and err.count == 1
+    with m.measure_time("op"):
+        pass
+    assert m.stat("op").count == 1  # success path unaffected
+    assert m.stat("op.error").count == 1
+
+    from indy_plenum_tpu.common.metrics_collector import (
+        NullMetricsCollector,
+    )
+
+    null = NullMetricsCollector()
+    with pytest.raises(ValueError):
+        with null.measure_time("op"):
+            raise ValueError("still propagates")
+    assert null.stat("op.error") is None
+
+
+def test_kv_collector_close_flushes_partial_window():
+    """Satellite: without close(), up to flush_every - 1 events are lost
+    on a clean shutdown; close() flushes them (Node.stop calls it)."""
+    from indy_plenum_tpu.storage.kv_store import KeyValueStorageInMemory
+
+    store = KeyValueStorageInMemory()
+    m = KvMetricsCollector(store, flush_every=1000)
+    for _ in range(7):
+        m.add_event("a")
+    assert KvMetricsCollector(store).load_persisted() == {}  # unflushed
+    m.close()
+    assert KvMetricsCollector(store).load_persisted()["a"]["count"] == 7
+    # the base collector's close() is a no-op (teardown can call it
+    # unconditionally)
+    MetricsCollector().close()
+
+
+def test_kv_collector_persists_and_reseeds_histograms():
+    """Satellite: governor.tick_interval dwell history must survive a
+    restart — histograms persist alongside stats (float buckets intact)."""
+    from indy_plenum_tpu.storage.kv_store import KeyValueStorageInMemory
+
+    store = KeyValueStorageInMemory()
+    m = KvMetricsCollector(store, flush_every=1000)
+    for bucket in (0.05, 0.05, 0.1, "other"):
+        m.add_to_histogram(MetricsName.GOVERNOR_TICK_INTERVAL, bucket)
+    m.add_event("a", 2.0)
+    m.close()
+
+    reopened = KvMetricsCollector(store)
+    hist = reopened.histogram(MetricsName.GOVERNOR_TICK_INTERVAL)
+    assert hist == {0.05: 2, 0.1: 1, "other": 1}
+    # keyspaces stay separate: histogram rows never read back as stats
+    assert not any(k.startswith("hist!")
+                   for k in reopened.load_persisted())
+    # and it keeps counting into the seeded history
+    reopened.add_to_histogram(MetricsName.GOVERNOR_TICK_INTERVAL, 0.05)
+    reopened.close()
+    assert KvMetricsCollector(store).histogram(
+        MetricsName.GOVERNOR_TICK_INTERVAL)[0.05] == 3

@@ -1,0 +1,387 @@
+"""Consensus flight recorder (observability.trace): determinism, bounds,
+phase analytics, flight-dump triggers, and the surfaces that report it.
+
+The determinism contract under test is the one README "Observability"
+documents: a seeded sim run (view changes, chaos and mesh included)
+produces a BYTE-identical trace dump — the trace is a checkable artifact
+like ``ordered_hash`` — and a disabled recorder changes nothing (ordered
+digests identical to an untraced run).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.observability.trace import (
+    NULL_TRACE,
+    TraceRecorder,
+    critical_path,
+    events_to_jsonl,
+    load_jsonl,
+    phase_percentiles,
+    to_chrome_trace,
+)
+from indy_plenum_tpu.simulation.pool import SimPool
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, start=0.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+# ----------------------------------------------------------------------
+# recorder units
+# ----------------------------------------------------------------------
+
+def test_ring_buffer_never_exceeds_capacity():
+    clock = FakeClock()
+    rec = TraceRecorder(clock, capacity=16)
+    for i in range(100):
+        clock.now = float(i)
+        rec.record("mark", args={"i": i})
+    assert len(rec) == 16
+    events = rec.events()
+    # the ring keeps the TAIL: newest event last, oldest 84 evicted
+    assert events[0]["args"]["i"] == 84 and events[-1]["args"]["i"] == 99
+    # seq keeps counting across evictions (global event ordering)
+    assert events[-1]["seq"] == 100
+
+
+def test_null_recorder_is_disabled_and_free():
+    assert not NULL_TRACE.enabled
+    NULL_TRACE.record("anything", args={"x": 1})
+    with NULL_TRACE.span("body"):
+        pass
+    snap = NULL_TRACE.trigger_dump("whatever")
+    assert snap["events"] == [] and len(NULL_TRACE) == 0
+
+
+def test_span_durations_and_jsonl_roundtrip(tmp_path):
+    clock = FakeClock(10.0)
+    rec = TraceRecorder(clock, node="node0")
+    with rec.span("work", args={"k": 1}):
+        clock.now += 0.5
+    rec.record("mark", cat="3pc", key=(0, 1, "d"))
+    ev = rec.events()
+    assert ev[0]["ts"] == 10.0 and ev[0]["dur"] == 0.5
+    assert ev[1]["key"] == [0, 1, "d"] and ev[1]["node"] == "node0"
+    path = rec.dump(str(tmp_path / "t.jsonl"))
+    assert load_jsonl(path) == ev
+    # hash is the jsonl fingerprint
+    assert rec.to_jsonl() == events_to_jsonl(ev)
+
+
+def test_flight_dump_snapshots_tail_and_is_bounded():
+    clock = FakeClock()
+    rec = TraceRecorder(clock, capacity=64, flight_tail=4)
+    for i in range(10):
+        rec.record(f"m{i}")
+    snap = rec.trigger_dump("test_reason", args={"why": "unit"})
+    assert snap["reason"] == "test_reason"
+    # tail includes the flight mark itself, newest last
+    assert snap["events"][-1]["name"] == "flight.test_reason"
+    assert len(snap["events"]) == 4
+    for _ in range(20):
+        rec.trigger_dump("again")
+    assert len(rec.dumps) == 8  # MAX_FLIGHT_DUMPS bound
+
+
+# ----------------------------------------------------------------------
+# phase analytics (synthetic lifecycle)
+# ----------------------------------------------------------------------
+
+def _synthetic_events():
+    """Two batches on one node + request marks: prepare dominates batch
+    1, execute dominates batch 2."""
+    clock = FakeClock()
+    rec = TraceRecorder(clock, node="")
+    def mark(ts, name, key, node="node0", cat="3pc"):
+        clock.now = ts
+        rec.record(name, cat=cat, node=node, key=key)
+
+    mark(0.0, "req.ingress", ("r1",), node="", cat="req")
+    mark(0.2, "req.finalised", ("r1",), node="", cat="req")
+    k1 = (0, 1, "d1")
+    mark(1.0, "3pc.preprepare", k1)
+    mark(4.0, "3pc.prepare_quorum", k1)
+    mark(5.0, "3pc.commit_quorum", k1)
+    mark(5.5, "3pc.ordered", k1)
+    mark(5.6, "3pc.executed", k1)
+    k2 = (0, 2, "d2")
+    mark(6.0, "3pc.preprepare", k2)
+    mark(6.5, "3pc.prepare_quorum", k2)
+    mark(7.0, "3pc.commit_quorum", k2)
+    mark(7.2, "3pc.ordered", k2)
+    mark(9.2, "3pc.executed", k2)
+    return rec.events()
+
+
+def test_phase_percentiles_shape_and_values():
+    stats = phase_percentiles(_synthetic_events())
+    assert stats["prepare"]["count"] == 2
+    assert stats["prepare"]["p50"] == pytest.approx(0.5)
+    assert stats["prepare"]["p99"] == pytest.approx(3.0)
+    assert stats["auth"] == {"count": 1, "p50": 0.2, "p90": 0.2,
+                             "p99": 0.2, "max": 0.2}
+    for st in stats.values():
+        assert st["p50"] <= st["p90"] <= st["p99"] <= st["max"]
+    # node filter: request marks (pool-level) still feed the auth phase
+    node0 = phase_percentiles(_synthetic_events(), node="node0")
+    assert node0["auth"]["count"] == 1
+    assert phase_percentiles(_synthetic_events(), node="ghost") \
+        .get("prepare") is None
+
+
+def test_critical_path_attribution():
+    cp = critical_path(_synthetic_events())
+    assert cp["batches"] == 2
+    # batch 1: prepare (3.0) dominates; batch 2: execute (2.0) dominates
+    assert cp["dominant"] == {"prepare": 1, "execute": 1}
+    shares = cp["phase_share"]
+    assert abs(sum(shares.values()) - 1.0) < 0.01
+    assert shares["prepare"] == max(shares.values())
+
+
+def test_chrome_trace_export_is_valid():
+    chrome = to_chrome_trace(_synthetic_events())
+    json.dumps(chrome)  # serializable
+    evs = chrome["traceEvents"]
+    phases = {e["ph"] for e in evs}
+    assert phases <= {"M", "i", "X"}
+    # process metadata names every node (incl. the pool pseudo-process)
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"pool", "node0"}
+    # timestamps are normalized micros, non-negative
+    assert all(e["ts"] >= 0 for e in evs if e["ph"] != "M")
+
+
+# ----------------------------------------------------------------------
+# pool integration: determinism + digest identity + triggers
+# ----------------------------------------------------------------------
+
+def _traced_pool(seed, trace=True, overrides=None):
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+        "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True,
+        **(overrides or {})})
+    return SimPool(n_nodes=4, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False, trace=trace)
+
+
+def test_traces_deterministic_and_disabled_recorder_changes_nothing():
+    """Same seed ⇒ byte-identical dump; trace=False ⇒ the exact ordering
+    digests of a traced run (recording never perturbs consensus)."""
+
+    def run(trace):
+        pool = _traced_pool(seed=23, trace=trace)
+        for i in range(25):
+            pool.submit_request(i)
+        pool.run_for(20)
+        assert pool.honest_nodes_agree()
+        return pool
+
+    p1, p2, p0 = run(True), run(True), run(False)
+    assert len(p1.trace) > 0
+    assert p1.trace.to_jsonl() == p2.trace.to_jsonl()
+    assert p1.trace.trace_hash() == p2.trace.trace_hash()
+    assert p0.ordered_hash() == p1.ordered_hash()
+    assert len(p0.trace) == 0  # NULL_TRACE recorded nothing
+    # the full lifecycle landed: every span category present
+    cats = {e["cat"] for e in p1.trace.events()}
+    assert {"3pc", "req", "dispatch"} <= cats
+    names = {e["name"] for e in p1.trace.events()}
+    assert {"3pc.preprepare", "3pc.prepare_quorum", "3pc.commit_quorum",
+            "3pc.ordered", "3pc.executed", "flush.dispatch",
+            "flush.readback", "tick.flush", "tick.eval",
+            "tick.governor"} <= names
+
+
+def test_ordering_stall_triggers_flight_dump():
+    """The PBFT stall watchdog firing is a flight-recorder moment: the
+    dump tail lands in trace.dumps with reason ordering_stall."""
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+                        "OrderingStallTimeout": 2.0})
+    pool = SimPool(n_nodes=4, seed=7, config=config, trace=True)
+    for i in range(3):
+        pool.submit_request(i)
+    pool.run_for(2)
+    # quorum denied: 2 of the 3 non-primary replicas go dark
+    primary = pool.nodes[0].data.primaries[0]
+    others = [n.name for n in pool.nodes if n.name != primary]
+    pool.network.disconnect(others[0])
+    pool.network.disconnect(others[1])
+    pool.submit_request(100)
+    pool.run_for(10)
+    reasons = {d["reason"] for d in pool.trace.dumps}
+    assert "ordering_stall" in reasons
+    flight = [e for e in pool.trace.events()
+              if e["name"] == "flight.ordering_stall"]
+    assert flight and flight[0]["args"]["view_no"] >= 0
+
+
+def test_governor_saturation_anomaly_dumps():
+    from indy_plenum_tpu.tpu.governor import (
+        ANOMALY_SATURATED_TICKS,
+        DispatchGovernor,
+    )
+
+    clock = FakeClock()
+    rec = TraceRecorder(clock)
+    gov = DispatchGovernor(0.1, 0.05, 0.4, trace=rec)
+    # saturated ticks: chained dispatches pin the interval at its floor
+    for i in range(ANOMALY_SATURATED_TICKS + 4):
+        clock.now = float(i)
+        gov.observe(votes=128, capacity=128, dispatches=3)
+    assert gov.interval == gov.min_interval
+    assert gov.anomalies == 1  # fires once per episode, not per tick
+    assert [d["reason"] for d in rec.dumps] == ["governor_saturated"]
+    assert gov.trajectory_summary()["anomalies"] == 1
+    # a relieved tick re-arms the episode detector
+    gov.observe(votes=0, capacity=128, dispatches=1)
+    for i in range(ANOMALY_SATURATED_TICKS):
+        gov.observe(votes=128, capacity=128, dispatches=3)
+    assert gov.anomalies == 2
+
+
+def test_monitor_snapshot_phase_latency_shape():
+    """Satellite: Monitor.snapshot() surfaces the per-phase latency
+    percentiles when the node carries a recorder (NodePool shares one)."""
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                        "PropagateBatchWait": 0.05})
+    pool = NodePool(4, seed=13, config=config, trace=True)
+    for _ in range(3):
+        pool.submit_to("node0", pool.make_nym_request())
+    pool.run_for(15)
+    assert all(len(n.ordered_digests) == 3 for n in pool.nodes)
+
+    snap = pool.node("node1").monitor.snapshot()
+    phases = snap["phase_latency"]
+    for required in ("prepare", "commit", "order", "execute", "auth"):
+        assert required in phases, (required, sorted(phases))
+        st = phases[required]
+        assert st["count"] > 0
+        assert st["p50"] <= st["p90"] <= st["p99"] <= st["max"]
+    # an untraced node reports no block at all (NULL recorder)
+    untraced = NodePool(4, seed=13, config=config)
+    assert "phase_latency" not in untraced.node("node0").monitor.snapshot()
+
+
+def test_trace_tool_cli(tmp_path):
+    dump = tmp_path / "t.jsonl"
+    dump.write_text(events_to_jsonl(_synthetic_events()))
+    chrome = tmp_path / "chrome.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "trace_tool.py"),
+         str(dump), "--json", "--chrome", str(chrome)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["phase_latency"]["prepare"]["count"] == 2
+    assert record["critical_path"]["batches"] == 2
+    loaded = json.loads(chrome.read_text())
+    assert loaded["traceEvents"]
+    # human-readable mode renders the percentile table
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "trace_tool.py"), str(dump)],
+        capture_output=True, text=True, timeout=120)
+    assert proc2.returncode == 0 and "p50=" in proc2.stdout
+
+
+# ----------------------------------------------------------------------
+# slow lane: the acceptance-shape determinism runs
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_trace_determinism_n8_k2_with_view_change():
+    """ISSUE acceptance: same seed ⇒ byte-identical dump at n=8/k=2
+    through a mid-run view change (adaptive tick, device quorum)."""
+
+    def run():
+        config = getConfig({
+            "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+            "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True})
+        pool = SimPool(n_nodes=8, seed=47, config=config,
+                       device_quorum=True, shadow_check=False,
+                       num_instances=2, trace=True)
+        primary = pool.nodes[0].data.primaries[0]
+        for i in range(8):
+            pool.submit_request(i)
+        pool.run_for(8)
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 108):
+            pool.submit_request(i)
+        pool.run_for(12)
+        survivors = [n for n in pool.nodes if n.name != primary]
+        assert all(n.data.view_no >= 1 for n in survivors)
+        assert all(len(n.ordered_digests) >= 16 for n in survivors)
+        return pool.trace
+
+    t1, t2 = run(), run()
+    assert len(t1) > 0
+    assert t1.to_jsonl() == t2.to_jsonl()
+    assert any(e["name"] == "vc.started" for e in t1.events())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_trace_determinism_f_crash_partition(tmp_path):
+    """ISSUE acceptance: a chaos run's trace replays bit-for-bit, and
+    the report carries the fingerprint + the chaos marks ride the same
+    timeline."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    dump = str(tmp_path / "chaos.trace.jsonl")
+    r1 = run_scenario("f_crash_partition", seed=5, trace=True,
+                      trace_out=dump)
+    r2 = run_scenario("f_crash_partition", seed=5, trace=True)
+    assert r1.trace_hash is not None
+    assert r1.trace_hash == r2.trace_hash
+    assert r1.verdict_as_expected
+    assert r1.dispatch_mode["trace"] is True
+    assert "--trace" in r1.replay_command
+    # the fault schedule rides the SAME timeline as the 3PC spans (a
+    # falsy-recorder regression here once silently dropped every chaos
+    # mark)
+    events = load_jsonl(dump)
+    assert any(ev["cat"] == "chaos" for ev in events)
+    assert any(ev["cat"] == "3pc" for ev in events)
+
+
+@pytest.mark.slow
+def test_mesh_trace_determinism(eight_devices):
+    """Mesh-sharded runs trace deterministically too (per-shard staging
+    and gathered readbacks included)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    def run():
+        mesh = Mesh(np.array(eight_devices[:4]), ("members",))
+        config = getConfig({
+            "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+            "QuorumTickInterval": 0.05})
+        pool = SimPool(n_nodes=8, seed=31, config=config,
+                       device_quorum=True, shadow_check=False,
+                       num_instances=2, mesh=mesh, trace=True)
+        for i in range(16):
+            pool.submit_request(i)
+        pool.run_for(20)
+        assert all(len(n.ordered_digests) == 16 for n in pool.nodes)
+        return pool
+
+    p1, p2 = run(), run()
+    assert p1.trace.trace_hash() == p2.trace.trace_hash()
+    assert p1.ordered_hash() == p2.ordered_hash()

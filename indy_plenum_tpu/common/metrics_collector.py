@@ -19,9 +19,21 @@ from typing import Any, Dict, Optional
 
 
 class MetricsName:
-    # ingress
+    # ingress — AUTH_BATCH_* measures work the device actually verified;
+    # the admission plane's shed/queue accounting lives under dedicated
+    # ingress.* names so overload never pollutes the hot-path stats
     AUTH_BATCH_SIZE = "auth.batch_size"
     AUTH_BATCH_TIME = "auth.batch_time"
+    # admission control (ingress/admission.py): pre-drain queue depth per
+    # tick (Stat.last = current, max = the bound actually reached),
+    # admitted/shed totals (Stat.total), and the device-proof read path's
+    # batch sizes / served counts / wall-clock qps gauge
+    INGRESS_QUEUE_DEPTH = "ingress.queue_depth"
+    INGRESS_ADMITTED = "ingress.admitted"
+    INGRESS_SHED = "ingress.shed"
+    READ_BATCH_SIZE = "ingress.read_batch_size"
+    READ_SERVED = "ingress.read_served"
+    READ_QPS = "ingress.read_qps"
     # 3PC
     BACKUP_ORDERED = "3pc.backup_ordered"
     ORDERED_BATCH_SIZE = "3pc.ordered_batch_size"

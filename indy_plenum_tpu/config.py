@@ -117,6 +117,24 @@ class Config:
     # the static ladder's exact behaviour.
     FlushLadderAdaptive: bool = True
 
+    # --- ingress plane (admission control + backpressure) -----------------
+    # Bounded auth queue (ingress/admission.py): client writes queue up to
+    # this many entries between dispatch ticks; overflow sheds
+    # deterministically (drop-newest, seeded tiebreak). 0 = unbounded
+    # (admission control off — the pre-PR 6 behaviour).
+    IngressQueueCapacity: int = 0
+    # Per-client fairness cap: a client with this many requests already
+    # queued is shed outright (0 = no cap). One hot wallet must not
+    # starve the population.
+    IngressPerClientCap: int = 0
+    # Shed tiebreak seed for DEPLOYED nodes (simulation pools use the
+    # pool seed so the shed set replays with the run).
+    IngressShedSeed: int = 0
+    # Backpressure law (governor.feed_backpressure): pre-drain queue
+    # depth at or above this fraction of capacity counts as queue growth
+    # and narrows the tick.
+    GovernorBackpressureQueueFrac: float = 0.5
+
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory
     LedgerStorageType: str = "chunked_file"

@@ -1,0 +1,33 @@
+"""Million-client ingress plane: open-loop workload, admission, reads.
+
+Three cooperating parts (README "Ingress plane"):
+
+- :mod:`.workload` — a seeded open-loop client population (Zipf-skewed
+  arrival rates and hot-key targets) scheduling arrivals on the pool's
+  virtual clock, so every run is replay-identical;
+- :mod:`.admission` — bounded auth queues with a deterministic shed
+  policy (drop-newest, seeded tiebreak, per-client fairness caps) and
+  the :class:`~indy_plenum_tpu.ingress.admission.BackpressureSignal`
+  that closes the loop into the dispatch governor;
+- :mod:`.read_service` — GET-style state reads answered from a ledger's
+  Merkle tree with the device audit-proof kernel, zero 3PC involvement.
+"""
+from .admission import AdmissionController, BackpressureSignal
+from .read_service import (
+    LedgerBacking,
+    ProofRead,
+    ReadService,
+    StaticCorpusBacking,
+)
+from .workload import WorkloadGenerator, WorkloadSpec
+
+__all__ = [
+    "AdmissionController",
+    "BackpressureSignal",
+    "LedgerBacking",
+    "ProofRead",
+    "ReadService",
+    "StaticCorpusBacking",
+    "WorkloadGenerator",
+    "WorkloadSpec",
+]

@@ -1,0 +1,227 @@
+"""Device-proof read path: state reads that never touch the 3PC plane.
+
+Plenum serves client reads the same way: one node answers from its
+committed state with proof material (root + path + pool signature) that
+makes a single answer trustworthy — reads ride no agreement round
+(PBFT §"read-only operations", Castro & Liskov 1999). Here the proof
+material is an RFC 6962 audit path against the serving ledger's root,
+and the node VERIFIES what it hands out using the batched device
+audit-proof kernel (the catchup kernel, ~170k proofs/sec device-side,
+BENCH_r05) — one device dispatch covers a whole drain's worth of reads.
+
+Contract (asserted by bench.py's ``saturation`` sub-bench and
+tests/test_ingress.py):
+
+- **zero 3PC involvement**: the service holds no reference to the vote
+  plane; serving reads changes neither ``vote_group.flushes`` nor
+  ``ordered_hash`` on the same seed;
+- reads are answered against a SNAPSHOT ``(tree_size, root)`` captured
+  at construction / :meth:`ReadService.refresh`, so a proof never
+  straddles a root that moved mid-batch;
+- per-drain batched verification: the whole batch rides ONE
+  :func:`~indy_plenum_tpu.server.catchup.catchup_rep_service
+  .verify_audit_paths_batch` call. The default ``mode="auto"`` consults
+  the catchup plane's MEASURED offload policy: the device kernel where
+  it wins (real TPU), the scalar SHA-NI loop where the link makes the
+  kernel a tax (CPU drivers) — same proofs, same verdicts either way.
+
+Backings adapt proof sources: :class:`LedgerBacking` serves a live
+ledger's committed txns (GET_TXN-style); :class:`StaticCorpusBacking`
+builds a seeded NYM/attrib corpus for workload benches where the read
+universe is the generator's hot-key space.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class ProofRead:
+    """One answered read: leaf bytes + the proof that they are in the
+    tree identified by ``root`` at ``tree_size``."""
+
+    index: int
+    leaf: bytes
+    root: bytes
+    path: List[bytes]
+    tree_size: int
+    verified: bool
+
+
+class StaticCorpusBacking:
+    """A seeded read corpus: ``n_keys`` deterministic NYM-record leaves
+    in a compact Merkle tree. Audit paths are cached per index — Zipf
+    read traffic concentrates on the head, so the cache hits almost
+    always after warm-up."""
+
+    def __init__(self, n_keys: int, seed: int = 0):
+        from ..ledger.compact_merkle_tree import CompactMerkleTree
+
+        if n_keys <= 0:
+            raise ValueError("n_keys must be positive")
+        self._leaves = [
+            b"nym|%d|%d|verkey-%d" % (seed, i, i) for i in range(n_keys)]
+        tree = CompactMerkleTree()
+        tree.extend(self._leaves)
+        self._tree = tree
+        self.tree_size = n_keys
+        self.root = tree.root_hash
+        self._path_cache: Dict[int, List[bytes]] = {}
+
+    def leaf(self, index: int) -> bytes:
+        return self._leaves[index]
+
+    def path(self, index: int) -> List[bytes]:
+        cached = self._path_cache.get(index)
+        if cached is None:
+            cached = self._tree.audit_path(index, self.tree_size)
+            self._path_cache[index] = cached
+        return cached
+
+
+class LedgerBacking:
+    """Committed-txn reads from a live :class:`~indy_plenum_tpu.ledger
+    .ledger.Ledger`. The (size, root) snapshot is captured at
+    construction; call :meth:`refresh` after new commits to serve (and
+    prove) the newer txns — refreshing invalidates the path cache, since
+    audit paths are per-tree-size."""
+
+    def __init__(self, ledger):
+        self._ledger = ledger
+        self.tree_size = 0
+        self.root = b""
+        self._path_cache: Dict[int, List[bytes]] = {}
+        self.refresh()
+
+    def refresh(self) -> None:
+        size = self._ledger.size
+        if size == self.tree_size:
+            return
+        self.tree_size = size
+        self.root = self._ledger.root_hash_at(size) if size else b""
+        self._path_cache.clear()
+
+    def leaf(self, index: int) -> bytes:
+        # the ledger's tree hashed the stored serialized bytes — return
+        # them verbatim (a loads/dumps round-trip per hot read would
+        # also make proofs depend on re-serialization stability)
+        return self._ledger.get_serialized(index + 1)
+
+    def path(self, index: int) -> List[bytes]:
+        cached = self._path_cache.get(index)
+        if cached is None:
+            cached = self._ledger.audit_path(index + 1, self.tree_size)
+            self._path_cache[index] = cached
+        return cached
+
+
+class ReadService:
+    """Batches GET-style reads and answers them with device-verified
+    proofs. ``clock`` (the pool's virtual clock) timestamps the
+    ``ingress.read`` trace marks so traces stay deterministic; the
+    wall-clock spent serving accumulates host-side only (``read_qps``)."""
+
+    def __init__(self, backing, clock: Optional[Callable[[], float]] = None,
+                 metrics=None, trace=None, max_batch: int = 16384,
+                 mode: str = "auto"):
+        from ..common.metrics_collector import MetricsCollector
+        from ..observability.trace import NULL_TRACE
+
+        # mode: "device" forces the audit-proof kernel, "host" the scalar
+        # verifier, "auto" (default) the catchup plane's MEASURED offload
+        # policy — on a real TPU the kernel wins (~170k proofs/sec,
+        # BENCH_r05); on a CPU driver the scalar SHA-NI loop does, and
+        # forcing the kernel there would tax the serving loop ~10x
+        # (the round-4 offload lesson, applied to reads)
+        self.mode = mode
+        self.backing = backing
+        self._clock = clock if clock is not None else (lambda: 0.0)
+        self.metrics = metrics if metrics is not None \
+            else MetricsCollector()
+        self.trace = trace if trace is not None else NULL_TRACE
+        self.max_batch = int(max_batch)
+        self._queue: List[int] = []
+        self.served_total = 0
+        self.verified_total = 0
+        self.serve_wall_s = 0.0
+
+    # ------------------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, index: int) -> None:
+        """Queue one read for the next drain; ``index`` is folded into
+        the backing's tree (the workload generator's key space may be
+        larger than the corpus)."""
+        size = self.backing.tree_size
+        if size <= 0:
+            raise ValueError("read backing is empty")
+        self._queue.append(index % size)
+
+    def read_one(self, index: int) -> ProofRead:
+        """Synchronous single read (tests / interactive use): the proof
+        still verifies — through the host tier below DEVICE_MIN_BATCH.
+        Anything already queued drains too; the reply for ``index`` is
+        the LAST one (drain answers in submission order)."""
+        self.submit(index)
+        return self.drain()[-1]
+
+    def drain(self) -> List[ProofRead]:
+        """Answer everything queued: gather leaves + cached paths, then
+        ONE batched audit-proof verification per ``max_batch`` chunk.
+        Returns the replies in submission order."""
+        if not self._queue:
+            return []
+        from ..common.metrics_collector import MetricsName
+        from ..server.catchup.catchup_rep_service import (
+            verify_audit_paths_batch,
+        )
+
+        queued, self._queue = self._queue, []
+        backing = self.backing
+        root, tree_size = backing.root, backing.tree_size
+        out: List[ProofRead] = []
+        t0 = time.perf_counter()
+        for lo in range(0, len(queued), self.max_batch):
+            chunk = queued[lo:lo + self.max_batch]
+            leaves = [backing.leaf(i) for i in chunk]
+            paths = [backing.path(i) for i in chunk]
+            verdicts = verify_audit_paths_batch(
+                leaves, chunk, paths, tree_size, root, mode=self.mode)
+            ok = int(verdicts.sum())
+            self.verified_total += ok
+            if self.trace.enabled:
+                self.trace.record(
+                    "ingress.read", cat="ingress",
+                    args={"batch": len(chunk), "ok": ok})
+            for i, leaf, path, good in zip(chunk, leaves, paths,
+                                           verdicts):
+                out.append(ProofRead(
+                    index=i, leaf=leaf, root=root, path=path,
+                    tree_size=tree_size, verified=bool(good)))
+        self.serve_wall_s += time.perf_counter() - t0
+        self.served_total += len(queued)
+        self.metrics.add_event(MetricsName.READ_BATCH_SIZE, len(queued))
+        self.metrics.add_event(MetricsName.READ_SERVED, len(queued))
+        if self.serve_wall_s > 0:
+            self.metrics.add_event(
+                MetricsName.READ_QPS,
+                self.served_total / self.serve_wall_s)
+        return out
+
+    # ------------------------------------------------------------------
+
+    def counters(self) -> Dict[str, object]:
+        qps = (self.served_total / self.serve_wall_s
+               if self.serve_wall_s > 0 else 0.0)
+        return {
+            "served": self.served_total,
+            "verified": self.verified_total,
+            "pending": self.depth,
+            "serve_wall_s": round(self.serve_wall_s, 4),
+            "read_qps": round(qps, 1),
+        }

@@ -1,0 +1,139 @@
+"""Open-loop workload generator: a seeded virtual client population.
+
+RBFT and PBFT both evaluate under sustained client load; plenum's pools
+face the open-loop version — millions of independent wallets whose
+arrival rate does not slow down because the pool is busy. This module
+models that population WITHOUT instantiating it: clients exist as a
+Zipf-skewed index space (client ``0`` is the hottest wallet) and keys as
+a second Zipf space (hot NYM/attrib targets), both sampled per arrival
+from one seeded RNG. Arrival times are a seeded Poisson process.
+
+Everything rides the pool's virtual clock: the generator schedules ONE
+timer event at a time (each arrival schedules its successor), so the
+timer heap stays O(1) no matter how many arrivals the run produces, and
+a seeded run is replay-identical — same arrival instants, same clients,
+same keys, same read/write choices. That determinism is what lets the
+admission plane's shed set and the pool's ``ordered_hash``/``trace_hash``
+be compared byte-for-byte across runs (tests/test_ingress.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """One client population. ``rate`` is arrivals per SIM second (open
+    loop — arrivals never wait for completions); ``read_fraction`` of
+    arrivals are state reads against the hot-key space; the Zipf
+    exponents (> 1) skew per-client activity and key popularity."""
+
+    n_clients: int = 1_000_000
+    rate: float = 100.0
+    duration: float = 30.0
+    start: float = 0.0
+    read_fraction: float = 0.0
+    zipf_clients: float = 1.1
+    zipf_keys: float = 1.2
+    n_keys: int = 4096
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.rate <= 0 or self.duration <= 0:
+            raise ValueError("rate and duration must be positive")
+        if not (0.0 <= self.read_fraction <= 1.0):
+            raise ValueError("read_fraction must be in [0, 1]")
+        if self.zipf_clients <= 1.0 or self.zipf_keys <= 1.0:
+            raise ValueError("zipf exponents must be > 1")
+
+
+class WorkloadGenerator:
+    """Schedules the population's arrivals onto an injected timer.
+
+    ``on_write(client_idx, key_idx)`` / ``on_read(client_idx, key_idx)``
+    fire at each arrival instant. The generator is single-use: one
+    :meth:`start` per instance (the RNG stream is the identity of the
+    run).
+    """
+
+    def __init__(self, spec: WorkloadSpec):
+        import numpy as np
+
+        self.spec = spec
+        self._rng = np.random.RandomState(spec.seed)
+        self._started = False
+        self._stopped = False
+        self.arrivals = 0
+        self.writes = 0
+        self.reads = 0
+
+    # ------------------------------------------------------------------
+
+    def _zipf_index(self, exponent: float, n: int) -> int:
+        """Zipf-distributed index in [0, n): unbounded Zipf draw folded
+        into the population (rank 0 is the hottest; the fold keeps the
+        head's skew intact because draws beyond ``n`` are rare)."""
+        return int(self._rng.zipf(exponent) - 1) % n
+
+    def stop(self) -> None:
+        """Cancel future arrivals (the pending timer event fires as a
+        no-op). Counters keep their values."""
+        self._stopped = True
+
+    def start(self, timer,
+              on_write: Callable[[int, int], None],
+              on_read: Optional[Callable[[int, int], None]] = None) -> None:
+        """Begin the open-loop arrival chain on ``timer``. Arrivals run
+        from ``spec.start`` (relative to the timer's clock) until the
+        first gap past ``spec.start + spec.duration``; read arrivals are
+        DROPPED when no ``on_read`` is wired — the RNG draws are still
+        consumed, so a reads-served and a reads-dropped run submit the
+        IDENTICAL write sequence (the bench's no-reads comparison arm
+        relies on it)."""
+        if self._started:
+            raise RuntimeError("generator already started")
+        self._started = True
+        spec = self.spec
+        # the window is RELATIVE to the timer's clock at start() —
+        # simulation pools begin at an epoch-like instant, and the
+        # generator must not care
+        begin = timer.get_current_time() + spec.start
+        end = begin + spec.duration
+        rng = self._rng
+
+        def fire() -> None:
+            if self._stopped:
+                return
+            client = self._zipf_index(spec.zipf_clients, spec.n_clients)
+            key = self._zipf_index(spec.zipf_keys, spec.n_keys)
+            is_read = (spec.read_fraction > 0.0
+                       and rng.random_sample() < spec.read_fraction)
+            self.arrivals += 1
+            if is_read:
+                self.reads += 1
+                if on_read is not None:
+                    on_read(client, key)
+            else:
+                self.writes += 1
+                on_write(client, key)
+            schedule_next()
+
+        def schedule_next() -> None:
+            gap = float(rng.exponential(1.0 / spec.rate))
+            due = timer.get_current_time() + gap
+            if due > end:
+                return
+            timer.schedule(gap, fire)
+
+        first_gap = float(rng.exponential(1.0 / spec.rate))
+        first = begin + first_gap
+        if first <= end:
+            timer.schedule(
+                max(first - timer.get_current_time(), 0.0), fire)
+
+    # ------------------------------------------------------------------
+
+    def counters(self) -> dict:
+        return {"arrivals": self.arrivals, "writes": self.writes,
+                "reads": self.reads}

@@ -64,6 +64,13 @@ class Ledger:
             raise KeyError(seq_no)
         return self.serializer.loads(self.txn_store.get(self._key(seq_no)))
 
+    def get_serialized(self, seq_no: int) -> bytes:
+        """Committed txn's STORED bytes — the exact leaf the Merkle tree
+        hashed (audit proofs are over these, not a re-serialization)."""
+        if not 1 <= seq_no <= self.seq_no:
+            raise KeyError(seq_no)
+        return self.txn_store.get(self._key(seq_no))
+
     def get_by_seq_no_uncommitted(self, seq_no: int) -> Dict[str, Any]:
         if seq_no <= self.seq_no:
             return self.get_by_seq_no(seq_no)

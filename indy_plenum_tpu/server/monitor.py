@@ -188,6 +188,29 @@ class Monitor:
                 device["shard_occupancy"] = occ_per_shard
             if device:
                 snap["device_dispatch"] = device
+            # ingress plane (admission control + device-proof reads):
+            # the bounded queue's current/peak depth, the admitted/shed
+            # totals the shed policy produced, and the read path's
+            # served count + wall-clock qps gauge. Absent entirely when
+            # the run never recorded ingress metrics (admission off, no
+            # reads) — existing snapshots stay byte-compatible.
+            ingress = {}
+            depth = self._metrics.stat(MetricsName.INGRESS_QUEUE_DEPTH)
+            if depth is not None:
+                ingress["queue_depth"] = {"current": depth.last,
+                                          "max": depth.max}
+            for label, name in (
+                    ("admitted", MetricsName.INGRESS_ADMITTED),
+                    ("shed", MetricsName.INGRESS_SHED),
+                    ("read_served", MetricsName.READ_SERVED)):
+                stat = self._metrics.stat(name)
+                if stat is not None:
+                    ingress[label] = int(stat.total)
+            read_qps = self._metrics.stat(MetricsName.READ_QPS)
+            if read_qps is not None:
+                ingress["read_qps"] = round(read_qps.last, 1)
+            if ingress:
+                snap["ingress"] = ingress
         if self._trace is not None and self._trace.enabled:
             # per-phase latency attribution (flight recorder): where this
             # node's ordered batches spent their time — prepare / commit

@@ -231,7 +231,20 @@ class Node:
             self.propagator,
             classify=self.boot.write_manager.ledger_id_for_request)
         self.stasher.subscribe(Propagate, self.propagator.process_propagate)
+        # _auth_queue holds RELAYED propagates (consensus traffic — never
+        # shed); with admission control on, CLIENT writes queue in the
+        # bounded AdmissionController instead and overflow sheds
+        # deterministically (ingress plane, README "Ingress plane")
         self._auth_queue: List[Request] = []
+        self.admission = None
+        if self.config.IngressQueueCapacity > 0:
+            from ..ingress.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                capacity=self.config.IngressQueueCapacity,
+                per_client_cap=self.config.IngressPerClientCap,
+                seed=self.config.IngressShedSeed,
+                clock=timer.get_current_time)
         # client message surface: digest -> client id, and the outbound
         # client messages (REQACK/REQNACK/REPLY) a transport would deliver
         self._req_clients: Dict[str, str] = {}
@@ -441,9 +454,9 @@ class Node:
         trace_on = self.trace.enabled
         if trace_on:
             with self.trace.span("tick.drain", node=self.name):
-                self._flush_auth_queue()
+                signal = self._flush_auth_queue()
         else:
-            self._flush_auth_queue()
+            signal = self._flush_auth_queue()
         plane = self.vote_plane
         before = (plane.flushes, plane.flush_votes_total,
                   plane.flush_capacity_total)
@@ -457,6 +470,10 @@ class Node:
                 args={"dispatches": dispatches,
                       "votes": plane.flush_votes_total - before[1]})
         if self._dispatch_governor is not None:
+            if signal is not None:
+                # the tick's ingress pressure joins the occupancy the
+                # governor already observes (same law as the pool driver)
+                self._dispatch_governor.feed_backpressure(signal)
             self._quorum_tick_timer.update_interval(
                 self._dispatch_governor.observe(
                     plane.flush_votes_total - before[1],
@@ -545,6 +562,11 @@ class Node:
         if self.trace.enabled:
             self.trace.record("req.ingress", cat="req", node=self.name,
                               key=(req.digest,))
+        if self.admission is not None:
+            # bounded ingress: the shed decision is made NOW (drop-newest,
+            # seeded tiebreak); the client's NACK and the shed accounting
+            # ride the next auth flush so the hot path stays one offer call
+            return self.admission.offer(req, client_id)
         self._auth_queue.append(req)
         return True
 
@@ -618,11 +640,54 @@ class Node:
         """Relayed PROPAGATE whose request we haven't authenticated."""
         self._auth_queue.append(req)
 
-    def _flush_auth_queue(self) -> None:
-        """ONE device batch authenticates everything queued this tick."""
-        if not self._auth_queue:
-            return
+    def _flush_auth_queue(self):
+        """ONE device batch authenticates everything queued this tick.
+
+        With admission control on, the tick's sheds settle here too —
+        under the dedicated ``req.shed`` trace event / ``ingress.shed``
+        metric and a client NACK, never under ``AUTH_BATCH_*`` (those
+        stats measure only work the device actually verified) — and the
+        drain returns the tick's :class:`~indy_plenum_tpu.ingress
+        .admission.BackpressureSignal` (pre-drain depth, sheds, leeching)
+        so the standalone quorum tick can feed the dispatch governor the
+        same pressure the pool-level driver does. Returns ``None`` when
+        admission is off."""
         batch, self._auth_queue = self._auth_queue, []
+        signal = None
+        if self.admission is not None:
+            from ..ingress.admission import BackpressureSignal
+
+            depth = self.admission.depth
+            admitted, shed = self.admission.drain()
+            signal = BackpressureSignal(
+                queue_depth=depth,
+                capacity=self.admission.capacity,
+                shed_delta=len(shed),
+                leeching=not self.data.is_participating)
+            self.metrics.add_event(MetricsName.INGRESS_QUEUE_DEPTH, depth)
+            if admitted:
+                self.metrics.add_event(MetricsName.INGRESS_ADMITTED,
+                                       len(admitted))
+            if shed:
+                self.metrics.add_event(MetricsName.INGRESS_SHED,
+                                       len(shed))
+                for req, reason in shed:
+                    if self.trace.enabled:
+                        self.trace.record("req.shed", cat="req",
+                                          node=self.name,
+                                          key=(req.digest,),
+                                          args={"reason": reason})
+                    self._to_client(
+                        self._req_clients.pop(req.digest, None),
+                        RequestNack(
+                            identifier=req.identifier, reqId=req.reqId,
+                            reason="ingress overloaded: request shed "
+                                   f"({reason})"))
+            # client writes first, then relayed propagates: both verify
+            # in the same device batch either way
+            batch = admitted + batch
+        if not batch:
+            return signal
         self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
         with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
             verdicts = self.authnr.authenticate_batch(batch)
@@ -639,6 +704,7 @@ class Node:
             self._to_client(client, RequestAck(
                 identifier=req.identifier, reqId=req.reqId))
             self.propagator.propagate(req, sender_client=client)
+        return signal
 
     def _to_client(self, client_id: Optional[str], msg) -> None:
         if client_id is None:

@@ -40,6 +40,17 @@ class NodePool:
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
              "PropagateBatchWait": 0.05})
+        # simulation contract (config.IngressShedSeed): sim pools seed
+        # the shed tiebreak from the POOL seed so the shed set replays
+        # with the run; an explicit IngressShedSeed in the config wins.
+        # replace(), not in-place: the caller's config may build other
+        # pools and must not inherit this pool's seed
+        if self.config.IngressQueueCapacity > 0 \
+                and not self.config.IngressShedSeed:
+            import dataclasses
+
+            self.config = dataclasses.replace(
+                self.config, IngressShedSeed=seed)
         self.timer = MockTimer(start_time=1_700_000_000.0)
         self.metrics = MetricsCollector()
         # pool-shared flight recorder on the virtual clock (deterministic
@@ -143,13 +154,41 @@ class NodePool:
         for node in self.nodes:
             node.start()
 
-        def drain_auth_queues() -> None:
+        _shed_seen: Dict[str, int] = {}
+
+        def drain_auth_queues():
             # ingress rides the dispatch tick: each node's queued signed
             # requests get one device auth batch before votes scatter
             # (the per-node PropagateBatchWait timer still covers the
-            # per-message mode and sub-interval bursts)
+            # per-message mode and sub-interval bursts). With admission
+            # control on, the drain aggregates the pool's backpressure —
+            # the BUSIEST node's queue depth, the tick's total sheds, and
+            # whether anyone is leeching — for the dispatch governor.
+            depth = shed = 0
+            bounded = False
             for nd in self.nodes:
-                nd._flush_auth_queue()
+                adm = nd.admission
+                if adm is not None:
+                    bounded = True
+                    depth = max(depth, adm.depth)
+                    # sheds since the LAST tick (offer-time sheds
+                    # included, not just ones settled by this flush)
+                    prev = _shed_seen.get(nd.name, 0)
+                    nd._flush_auth_queue()
+                    shed += adm.shed_total - prev
+                    _shed_seen[nd.name] = adm.shed_total
+                else:
+                    nd._flush_auth_queue()
+            if not bounded:
+                return None
+            from ..ingress.admission import BackpressureSignal
+
+            return BackpressureSignal(
+                queue_depth=depth,
+                capacity=self.config.IngressQueueCapacity,
+                shed_delta=shed,
+                leeching=any(not nd.data.is_participating
+                             for nd in self.nodes))
 
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,

@@ -408,6 +408,19 @@ class SimPool:
             self.authnr = CoreAuthNr(seed_keys={
                 self.trustee.identifier: self.trustee.verkey})
         self._ingress: List[Request] = []
+        # admission control (ingress plane): a bounded auth queue with
+        # the deterministic shed policy replaces the unbounded _ingress
+        # list. The controller's tiebreak is seeded with the POOL seed,
+        # so a seeded saturation run replays to the byte-identical shed
+        # set (admission.shed_hash(), checkable like ordered_hash).
+        self.admission = None
+        if sign_requests and self.config.IngressQueueCapacity > 0:
+            from ..ingress.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                capacity=self.config.IngressQueueCapacity,
+                per_client_cap=self.config.IngressPerClientCap,
+                seed=seed, clock=self.timer.get_current_time)
 
         self.bls_keys = None
         if bls:
@@ -518,10 +531,12 @@ class SimPool:
         # recorded during the wave buffer for the next tick. Signed
         # ingress rides the same tick: requests submitted during the
         # interval get ONE device batch verify at tick start.
+        self._last_ingress_depth = 0
+        self._last_ingress_shed = 0
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,
             accounting=self.host_seconds,
-            ingress=(self.flush_ingress if self.authnr is not None
+            ingress=(self._ingress_tick if self.authnr is not None
                      else None),
             trace=self.trace)
         # adaptive tick mode: the governor's interval trajectory is a
@@ -573,7 +588,11 @@ class SimPool:
     def primary(self) -> SimNode:
         return self.node(self.nodes[0].data.primaries[0])
 
-    def submit_request(self, seq: int) -> Request:
+    def submit_request(self, seq: int,
+                       client_id: Optional[str] = None) -> Request:
+        # client_id: the ingress plane's virtual-client identity — the
+        # admission controller's per-client fairness cap keys on it
+        # (None = anonymous, outside any cap)
         if self.real_execution:
             from ..common.constants import NYM, TARGET_NYM, TXN_TYPE, VERKEY
             from ..crypto.signers import DidSigner
@@ -592,7 +611,10 @@ class SimPool:
             self.trace.record("req.ingress", cat="req", key=(req.digest,))
         if self.sign_requests:
             self.trustee.sign_request(req)
-            self._ingress.append(req)
+            if self.admission is not None:
+                self.admission.offer(req, client_id)
+            else:
+                self._ingress.append(req)
         else:
             self.requests.add_finalised(req)
             if self.trace.enabled:
@@ -612,16 +634,40 @@ class SimPool:
         signed requests; only verified ones become finalised. Returns the
         verdict vector (test observability). In tick-batched mode the
         dispatch-plane tick calls this automatically, so every request
-        submitted during the interval rides ONE Ed25519 device dispatch."""
-        if not self._ingress:
-            return []
-        batch, self._ingress = self._ingress, []
+        submitted during the interval rides ONE Ed25519 device dispatch.
+
+        With admission control on, the drain also settles the tick's shed
+        accounting: shed requests land under the DEDICATED ``req.shed``
+        trace event and ``ingress.shed`` metric — never under the
+        ``AUTH_BATCH_*`` hot-path stats, which measure only work the
+        device actually verified."""
         from ..common.metrics_collector import MetricsName
 
+        trace_on = self.trace.enabled
+        if self.admission is not None:
+            self._last_ingress_depth = self.admission.depth
+            batch, shed = self.admission.drain()
+            self._last_ingress_shed = len(shed)
+            self.metrics.add_event(MetricsName.INGRESS_QUEUE_DEPTH,
+                                   self._last_ingress_depth)
+            if batch:
+                self.metrics.add_event(MetricsName.INGRESS_ADMITTED,
+                                       len(batch))
+            if shed:
+                self.metrics.add_event(MetricsName.INGRESS_SHED,
+                                       len(shed))
+                if trace_on:
+                    for req, reason in shed:
+                        self.trace.record("req.shed", cat="req",
+                                          key=(req.digest,),
+                                          args={"reason": reason})
+        else:
+            batch, self._ingress = self._ingress, []
+        if not batch:
+            return []
         self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
         with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
             verdicts = self.authnr.authenticate_batch(batch)
-        trace_on = self.trace.enabled
         if trace_on:
             self.trace.record("tick.auth", cat="dispatch",
                               args={"batch": len(batch),
@@ -634,6 +680,25 @@ class SimPool:
                     self.trace.record("req.finalised", cat="req",
                                       key=(req.digest,))
         return list(verdicts)
+
+    def _ingress_tick(self):
+        """The dispatch tick's ingress drain. With admission control on,
+        returns the tick's :class:`~indy_plenum_tpu.ingress.admission
+        .BackpressureSignal` (pre-drain queue depth, sheds, leeching) —
+        the quorum driver hands it to the dispatch governor, closing the
+        PR 3 "widen while leeching" loop. Without admission this is just
+        ``flush_ingress``."""
+        self.flush_ingress()
+        if self.admission is None:
+            return None
+        from ..ingress.admission import BackpressureSignal
+
+        return BackpressureSignal(
+            queue_depth=self._last_ingress_depth,
+            capacity=self.admission.capacity,
+            shed_delta=self._last_ingress_shed,
+            leeching=any(not nd.data.is_participating
+                         for nd in self.nodes))
 
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)

@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from ..common.metrics_collector import MetricsName
 from ..common.timer import RepeatingTimer, TimerService
 from ..config import Config
+from ..ingress.admission import BackpressureSignal
 from ..observability.trace import _NO_SPAN
 
 
@@ -109,13 +110,17 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
     def tick() -> None:
         # ingress stays OUTSIDE the accounted window: SimPool's shared
         # ingress is a pool-level stand-in — charging its auth batch to
-        # every node's host_seconds would n-fold over-count it
+        # every node's host_seconds would n-fold over-count it. The
+        # drain's return value may be a BackpressureSignal (admission
+        # plane): queue depth / sheds / leeching feed the governor's
+        # law alongside the flush occupancy it already observes.
+        drained = None
         if ingress is not None:
             if trace.enabled:
                 with trace.span("tick.drain"):
-                    ingress()
+                    drained = ingress()
             else:
-                ingress()
+                drained = ingress()
         t0 = perf_counter() if accounting is not None else 0.0
         vote_group.flush()
         dispatches = vote_group.flushes - last[0]
@@ -127,6 +132,8 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                                "votes": vote_group.flush_votes_total
                                - last[1]})
         if governor is not None:
+            if isinstance(drained, BackpressureSignal):
+                governor.feed_backpressure(drained)
             new_interval = governor.observe_shards(
                 [a - b for a, b in zip(vote_group.flush_votes_per_shard,
                                        last_shard[0])],

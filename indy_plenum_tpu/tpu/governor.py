@@ -62,6 +62,7 @@ class DispatchGovernor:
                  max_interval: float, alpha: float = 0.3,
                  occupancy_low: float = 0.02, occupancy_high: float = 0.85,
                  widen: float = 1.5, narrow: float = 0.5,
+                 backpressure_queue_frac: float = 0.5,
                  metrics: Optional[MetricsCollector] = None,
                  trace=None):
         if not (0.0 < min_interval <= max_interval):
@@ -80,6 +81,14 @@ class DispatchGovernor:
         self.occupancy_high = float(occupancy_high)
         self.widen = float(widen)
         self.narrow = float(narrow)
+        self.backpressure_queue_frac = float(backpressure_queue_frac)
+        # ingress backpressure (ingress/admission.BackpressureSignal):
+        # fed once per tick by the ingress drain, consumed by the NEXT
+        # observe call. None = no signal — the law is then bit-identical
+        # to the PR 3/PR 4 occupancy-only law.
+        self._backpressure = None
+        self.backpressure_narrows = 0
+        self.backpressure_widens = 0
         self.ewma: Optional[float] = None  # occupancy EWMA (None = cold)
         # per-shard EWMAs (mesh-sharded dispatch plane): one series per
         # shard, all fed the same law; ``ewma`` above is always the
@@ -135,6 +144,30 @@ class DispatchGovernor:
         elif self.ewma <= self.occupancy_low:
             self.interval = min(self.interval * self.widen,
                                 self.max_interval)
+        # ingress backpressure (PR 3's open "widen while leeching" hook):
+        # queue growth or shedding narrows ON TOP of the occupancy law —
+        # draining the auth queue sooner is the only relief the tick can
+        # offer — while a leeching pool widens: a node replaying ledger
+        # catchup gains nothing from tight ticks, and wider ticks hand
+        # the host loop to the leecher. Queue growth outranks leeching
+        # (a full queue hurts now; catchup tolerates latency). Narrowing
+        # here counts as saturation for the anomaly trigger: pinned at
+        # the floor with the queue still growing is exactly the moment a
+        # trace tail is worth keeping.
+        sig, self._backpressure = self._backpressure, None
+        if sig is not None:
+            growth = sig.shed_delta > 0 or (
+                sig.capacity > 0 and sig.queue_depth
+                >= sig.capacity * self.backpressure_queue_frac)
+            if growth:
+                self.interval = max(self.interval * self.narrow,
+                                    self.min_interval)
+                self.backpressure_narrows += 1
+                saturated = True
+            elif sig.leeching:
+                self.interval = min(self.interval * self.widen,
+                                    self.max_interval)
+                self.backpressure_widens += 1
         # anomaly: pinned at the floor AND still saturated — narrowing
         # can't relieve the load anymore. Fires ONCE per episode (the
         # counter only rearms after a non-saturated tick), deterministic
@@ -170,6 +203,15 @@ class DispatchGovernor:
                     ewma)
         return self.interval
 
+    def feed_backpressure(self, signal) -> None:
+        """Hand the NEXT :meth:`observe`/:meth:`observe_shards` call one
+        tick's :class:`~indy_plenum_tpu.ingress.admission
+        .BackpressureSignal`. Feeding ``None`` (or never feeding) leaves
+        the law bit-identical to the occupancy-only PR 3/PR 4 law —
+        deterministic either way, since the signal itself is a pure
+        function of the seeded workload."""
+        self._backpressure = signal
+
     # ------------------------------------------------------------------
 
     def trajectory_summary(self) -> dict:
@@ -194,6 +236,9 @@ class DispatchGovernor:
                                if self.ewma is not None else None),
             "anomalies": self.anomalies,
         }
+        if self.backpressure_narrows or self.backpressure_widens:
+            out["backpressure_narrows"] = self.backpressure_narrows
+            out["backpressure_widens"] = self.backpressure_widens
         if self.shard_ewmas is not None and len(self.shard_ewmas) > 1:
             out["shards"] = len(self.shard_ewmas)
             out["shard_occupancy_ewma"] = [
@@ -214,4 +259,6 @@ class DispatchGovernor:
                    occupancy_high=config.GovernorOccupancyHigh,
                    widen=config.GovernorWiden,
                    narrow=config.GovernorNarrow,
+                   backpressure_queue_frac=(
+                       config.GovernorBackpressureQueueFrac),
                    metrics=metrics, trace=trace)

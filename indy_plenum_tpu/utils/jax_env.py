@@ -23,3 +23,24 @@ def ensure_host_platform_devices(n: int) -> None:
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+# the ONE name for the persistent XLA compile cache shared by the test
+# suite and every entry-point script (tests/conftest.py, bench.py,
+# profile_rbft.py, ingress_run.py): the SHA-512/Ed25519 kernels cost
+# tens of seconds to minutes of XLA:CPU compile, and each cold process
+# re-pays them without it
+PERSISTENT_COMPILE_CACHE_DIR = "/tmp/jax_cache_indy_plenum_tests"
+
+
+def enable_persistent_compile_cache(
+        path: str = PERSISTENT_COMPILE_CACHE_DIR,
+        min_compile_secs: float = 2.0) -> None:
+    """Point jax at the shared persistent compile cache. Unlike the
+    env-var helper above this IMPORTS jax — call it from entry points
+    only, after any platform overrides are in place."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

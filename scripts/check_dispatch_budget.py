@@ -35,6 +35,16 @@ more than ``--trace-tolerance`` below the untraced run. The wall-clock
 ratio is recorded alongside, so the recorder can never silently tax the
 hot path.
 
+Ingress gate (PR 6): unless ``--no-ingress-gate``, the script drives the
+seeded open-loop workload generator at n=16/k=6 through the SIGNED auth
+path twice — unsaturated vs well beyond the bounded admission queue's
+drain rate — and fails if overload grows the queue past capacity, if the
+shed set / ordering are not byte-identical across two identical
+saturated runs, if the unsaturated baseline sheds at all, or if
+ordered/sim-second under saturation collapses more than
+``--ingress-tolerance`` below the unsaturated run (admission exists to
+protect goodput, not to trade it away).
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
@@ -291,6 +301,138 @@ def tracing_gate(args, base: "dict | None" = None) -> "tuple[dict, list]":
     return record, failures
 
 
+def _measure_saturation(args, rate: float, seed: int) -> dict:
+    """One open-loop ingress run at the acceptance shape (n=16/k=6 by
+    default): the seeded workload generator drives the SIGNED auth path
+    through a bounded admission queue for ``--ingress-duration`` sim
+    seconds at ``rate`` arrivals/sim-second, then the pool settles."""
+    from indy_plenum_tpu.ingress import WorkloadGenerator, WorkloadSpec
+
+    config = getConfig({
+        "Max3PCBatchSize": 40,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": args.tick,
+        "QuorumTickAdaptive": True,
+        "IngressQueueCapacity": args.ingress_capacity,
+    })
+    pool = SimPool(n_nodes=args.sharded_nodes, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   num_instances=args.sharded_instances,
+                   sign_requests=True)
+
+    def min_ordered():
+        return min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    # warm-up OUTSIDE the measured window: a sub-capacity wave orders
+    # once, compiling the signed-ingress + vote-plane shapes (a cold
+    # XLA compile would otherwise eat the wall deadline and truncate
+    # the measurement). Deterministic: ordering progress is a pure
+    # function of the seed, so both saturated runs warm identically.
+    warm = max(2, args.ingress_capacity // 2)
+    for i in range(warm):
+        pool.submit_request(10_000_000 + i, client_id="warm")
+    deadline = time.monotonic() + 300
+    while min_ordered() < warm and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert min_ordered() >= warm, "ingress-gate warm-up stalled"
+    warm_ordered = min_ordered()
+
+    seq = [0]
+
+    def on_write(client: int, key: int) -> None:
+        seq[0] += 1
+        pool.submit_request(seq[0], client_id="c%d" % client)
+
+    gen = WorkloadGenerator(WorkloadSpec(
+        n_clients=100_000, rate=rate, duration=args.ingress_duration,
+        read_fraction=0.0, n_keys=64, seed=seed))
+    gen.start(pool.timer, on_write)
+
+    sim_t0 = pool.timer.get_current_time()
+    horizon = args.ingress_duration + 8.0
+    elapsed = 0.0
+    deadline = time.monotonic() + 300
+    while (elapsed < horizon or pool.admission.depth) \
+            and time.monotonic() < deadline:
+        pool.run_for(0.5)
+        elapsed += 0.5
+    assert pool.honest_nodes_agree()
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
+    adm = pool.admission
+    ordered = min_ordered() - warm_ordered
+    return {
+        "rate": rate,
+        "arrivals": gen.arrivals,
+        "admitted": adm.admitted_total - warm,  # warm-up wave excluded
+        "shed": adm.shed_total,
+        "peak_queue_depth": adm.peak_depth,
+        "capacity": adm.capacity,
+        "shed_hash": adm.shed_hash(),
+        "ordered": ordered,
+        "ordered_per_sim_second": round(ordered / sim_elapsed, 2)
+        if sim_elapsed else None,
+        "ordered_hash": pool.ordered_hash(),
+        "governor": (pool.governor.trajectory_summary()
+                     if pool.governor is not None else None),
+    }
+
+
+def ingress_gate(args) -> "tuple[dict, list]":
+    """Saturation gate (ingress plane): at n=16/k=6, open-loop overload
+    must shed DETERMINISTICALLY behind a bounded queue — never grow it
+    past capacity — and goodput under saturation must stay within
+    ``--ingress-tolerance`` of the unsaturated run (admission exists to
+    protect throughput, not to trade it away). Two saturated runs on the
+    same seed must produce the byte-identical shed set and ordering."""
+    if args.ingress_capacity < 1:
+        raise SystemExit(
+            "--ingress-capacity must be >= 1 for the ingress gate "
+            "(capacity 0 disables admission control entirely; pass "
+            "--no-ingress-gate to skip the gate instead)")
+    unsat = _measure_saturation(args, args.ingress_unsat_rate,
+                                seed=args.seed)
+    sat = _measure_saturation(args, args.ingress_rate, seed=args.seed)
+    sat2 = _measure_saturation(args, args.ingress_rate, seed=args.seed)
+    failures = []
+    if unsat["shed"] > 0:
+        failures.append(
+            f"unsaturated run shed {unsat['shed']} requests "
+            "(gate baseline must run below capacity)")
+    if sat["shed"] == 0:
+        failures.append("saturated run shed nothing (rate "
+                        f"{args.ingress_rate} does not overload capacity "
+                        f"{args.ingress_capacity})")
+    if sat["peak_queue_depth"] > sat["capacity"]:
+        failures.append(
+            f"queue grew past capacity: peak {sat['peak_queue_depth']} "
+            f"> {sat['capacity']}")
+    if sat2["shed_hash"] != sat["shed_hash"]:
+        failures.append("shed set is not deterministic across identical "
+                        "saturated runs")
+    if sat2["ordered_hash"] != sat["ordered_hash"]:
+        failures.append("ordering diverged across identical saturated "
+                        "runs")
+    tol = args.ingress_tolerance
+    u_tps = unsat["ordered_per_sim_second"] or 0.0
+    s_tps = sat["ordered_per_sim_second"] or 0.0
+    if s_tps < u_tps * (1.0 - tol):
+        failures.append(f"saturated ordered/sim-sec {s_tps} collapsed "
+                        f"below unsaturated {u_tps} beyond {tol:.0%}")
+    record = {
+        "unsaturated": unsat,
+        "saturated": sat,
+        "ingress_tolerance": tol,
+        "shed_deterministic": sat2["shed_hash"] == sat["shed_hash"],
+        "ordered_deterministic":
+            sat2["ordered_hash"] == sat["ordered_hash"],
+        "saturation_throughput_ratio": round(s_tps / u_tps, 3)
+        if u_tps else None,
+        "shed_fraction": round(
+            sat["shed"] / max(sat["arrivals"], 1), 4),
+    }
+    return record, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -309,6 +451,22 @@ def main() -> int:
                     help="skip the 1-device vs mesh-sharded comparison")
     ap.add_argument("--no-trace-gate", action="store_true",
                     help="skip the flight-recorder overhead comparison")
+    ap.add_argument("--no-ingress-gate", action="store_true",
+                    help="skip the open-loop saturation/admission gate")
+    ap.add_argument("--ingress-capacity", type=int, default=16,
+                    help="bounded auth-queue capacity for the ingress "
+                         "gate (small on purpose: overload must engage "
+                         "within the short gate window)")
+    ap.add_argument("--ingress-rate", type=float, default=700.0,
+                    help="saturated arrivals/sim-second (must overload "
+                         "the queue at the starting tick)")
+    ap.add_argument("--ingress-unsat-rate", type=float, default=120.0,
+                    help="unsaturated baseline arrivals/sim-second")
+    ap.add_argument("--ingress-duration", type=float, default=1.0,
+                    help="arrival window, sim-seconds")
+    ap.add_argument("--ingress-tolerance", type=float, default=0.10,
+                    help="max fractional ordered/sim-second collapse the "
+                         "saturated run may show vs the unsaturated run")
     ap.add_argument("--trace-tolerance", type=float, default=0.05,
                     help="max fractional ordered/sim-second regression "
                          "the recorder-enabled run may show vs disabled")
@@ -361,6 +519,10 @@ def main() -> int:
     if not args.no_trace_gate:
         record, failures = tracing_gate(args, base=sharded_single)
         result["tracing_gate"] = record
+        over.extend(failures)
+    if not args.no_ingress_gate:
+        record, failures = ingress_gate(args)
+        result["ingress_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

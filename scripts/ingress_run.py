@@ -1,0 +1,182 @@
+"""Ingress-plane driver: open-loop load + admission + reads, end to end.
+
+Runs the seeded million-client workload generator against a tick-batched
+``SimPool`` with admission control armed, serves the read mix through the
+device-proof :class:`~indy_plenum_tpu.ingress.read_service.ReadService`,
+and emits ONE machine-readable JSON line: arrivals/admitted/shed, the
+shed-set fingerprint, sustained ordered/sim-second, p50/p99
+``req.ingress -> req.finalised`` latency from the flight-recorder spans,
+read qps, ``ordered_hash`` and ``trace_hash``. Same seed => byte-identical
+record fields (the wall-clock ones excepted) — replay a saturation
+incident exactly.
+
+Usage:
+    python scripts/ingress_run.py --nodes 16 --rate 400 --duration 20 \
+        --capacity 256 --read-fraction 0.5 --json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# shared persistent XLA compile cache: on XLA:CPU the auth/flush kernels
+# otherwise cost minutes of cold compile per invocation of this script
+from indy_plenum_tpu.utils.jax_env import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache()
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.ingress import (  # noqa: E402
+    ReadService,
+    StaticCorpusBacking,
+    WorkloadGenerator,
+    WorkloadSpec,
+)
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+
+
+def build_pool(args) -> SimPool:
+    config = getConfig({
+        "Max3PCBatchSize": args.batch_size,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": args.tick,
+        "QuorumTickAdaptive": not args.static_tick,
+        "IngressQueueCapacity": args.capacity,
+        "IngressPerClientCap": args.per_client_cap,
+    })
+    return SimPool(n_nodes=args.nodes, seed=args.seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   sign_requests=True, num_instances=args.instances,
+                   trace=True, trace_capacity=args.trace_capacity)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=16)
+    ap.add_argument("--instances", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=80)
+    ap.add_argument("--tick", type=float, default=0.1)
+    ap.add_argument("--static-tick", action="store_true",
+                    help="freeze the tick (skip the adaptive governor)")
+    ap.add_argument("--seed", type=int, default=11)
+    # workload (open loop — arrivals never wait for completions)
+    ap.add_argument("--clients", type=int, default=1_000_000,
+                    help="virtual client population (Zipf-skewed)")
+    ap.add_argument("--rate", type=float, default=400.0,
+                    help="arrivals per sim-second")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="arrival window, sim-seconds")
+    ap.add_argument("--settle", type=float, default=20.0,
+                    help="extra sim-seconds to drain after arrivals stop")
+    ap.add_argument("--read-fraction", type=float, default=0.5)
+    ap.add_argument("--zipf-clients", type=float, default=1.1)
+    ap.add_argument("--zipf-keys", type=float, default=1.2)
+    ap.add_argument("--keys", type=int, default=16384,
+                    help="hot-key universe (NYM/attrib read corpus)")
+    # admission
+    ap.add_argument("--capacity", type=int, default=256,
+                    help="bounded auth-queue capacity (per tick drain)")
+    ap.add_argument("--per-client-cap", type=int, default=0)
+    ap.add_argument("--trace-capacity", type=int, default=1 << 20)
+    ap.add_argument("--trace-out", default=None,
+                    help="dump the span trace as JSONL (trace_tool.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable stdout line")
+    args = ap.parse_args()
+    if args.capacity < 1:
+        # SimPool only arms the admission plane for a positive capacity —
+        # fail here, not with an AttributeError after the full run
+        ap.error("--capacity must be >= 1 (0 disables admission control, "
+                 "which this driver exists to measure)")
+
+    pool = build_pool(args)
+    reads = ReadService(StaticCorpusBacking(args.keys, seed=args.seed),
+                        clock=pool.timer.get_current_time,
+                        metrics=pool.metrics, trace=pool.trace)
+    # warm the read-verify kernel outside the measured window (first call
+    # pays XLA compile)
+    reads.submit(0)
+    for i in range(63):
+        reads.submit(i)
+    reads.drain()
+    reads.served_total = reads.verified_total = 0
+    reads.serve_wall_s = 0.0
+
+    seq = [0]
+
+    def on_write(client: int, key: int) -> None:
+        seq[0] += 1
+        pool.submit_request(seq[0], client_id="c%d" % client)
+
+    gen = WorkloadGenerator(WorkloadSpec(
+        n_clients=args.clients, rate=args.rate, duration=args.duration,
+        read_fraction=args.read_fraction,
+        zipf_clients=args.zipf_clients, zipf_keys=args.zipf_keys,
+        n_keys=args.keys, seed=args.seed))
+    gen.start(pool.timer, on_write,
+              on_read=lambda client, key: reads.submit(key))
+
+    sim_t0 = pool.timer.get_current_time()
+    wall_t0 = time.perf_counter()
+    horizon = args.duration + args.settle
+    step = 0.5
+    elapsed = 0.0
+    while elapsed < horizon:
+        pool.run_for(step)
+        elapsed += step
+        reads.drain()  # reads ride the driver loop: zero 3PC involvement
+    wall_s = time.perf_counter() - wall_t0
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
+
+    assert pool.honest_nodes_agree(), "pool diverged under load"
+    ordered = min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    from indy_plenum_tpu.observability.trace import phase_percentiles
+
+    phases = phase_percentiles(pool.trace.events())
+    adm = pool.admission
+    record = {
+        "nodes": args.nodes,
+        "instances": args.instances,
+        "seed": args.seed,
+        "workload": gen.counters(),
+        "admission": adm.counters(),
+        "shed_fraction": round(adm.shed_total / max(adm.offered_total, 1),
+                               4),
+        "shed_hash": adm.shed_hash(),
+        "ordered": ordered,
+        "ordered_per_sim_second": round(ordered / sim_elapsed, 2)
+        if sim_elapsed else None,
+        "sim_elapsed_s": round(sim_elapsed, 2),
+        "wall_s": round(wall_s, 2),
+        # the acceptance latency: earliest req.ingress anywhere ->
+        # earliest req.finalised, per request, from the trace spans
+        "ingress_to_finalised": phases.get("auth"),
+        "reads": reads.counters(),
+        "ordered_hash": pool.ordered_hash(),
+        "trace_hash": pool.trace.trace_hash(),
+        "governor": (pool.governor.trajectory_summary()
+                     if pool.governor is not None else None),
+    }
+    if args.trace_out:
+        pool.trace.dump(args.trace_out)
+        record["trace_file"] = args.trace_out
+    if args.json:
+        print(json.dumps(record, separators=(",", ":")))
+    else:
+        for key, value in record.items():
+            print(f"{key}: {value}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

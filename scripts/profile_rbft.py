@@ -45,6 +45,14 @@ if "--mesh" in sys.argv:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# shared persistent XLA compile cache: without it every invocation
+# re-pays minutes of XLA:CPU kernel compile, and the 240s run deadlines
+# can expire mid-compile on a small host
+from indy_plenum_tpu.utils.jax_env import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache()
 
 from indy_plenum_tpu.common.metrics_collector import MetricsName  # noqa: E402
 from indy_plenum_tpu.config import getConfig  # noqa: E402
@@ -54,16 +62,19 @@ BATCH = 160
 
 
 def _build_pool(n, k, tick_interval, adaptive=False, mesh=None,
-                trace=False):
+                trace=False, ingress_capacity=0):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": tick_interval,
         "QuorumTickAdaptive": adaptive,
+        "IngressQueueCapacity": ingress_capacity,
     })
+    # a bounded ingress queue only means something on the signed auth
+    # path (the admission plane guards the device auth batch)
     return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
                    shadow_check=False, num_instances=k, mesh=mesh,
-                   trace=trace)
+                   trace=trace, sign_requests=ingress_capacity > 0)
 
 
 def _run(pool, txns, profile=False):
@@ -79,15 +90,28 @@ def _run(pool, txns, profile=False):
     def min_ordered():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
 
+    def target_after_shed(base):
+        # a bounded admission queue (--ingress-capacity) sheds overflow
+        # deterministically: only what was ADMITTED can ever order
+        adm = pool.admission
+        return base - adm.shed_total if adm is not None else base
+
     # warm-up: compiles the vote-plane step shapes + fills jit caches
     deadline = time.monotonic() + 240
     submit(BATCH)
-    while min_ordered() < BATCH and time.monotonic() < deadline:
+    while min_ordered() < target_after_shed(BATCH) \
+            and time.monotonic() < deadline:
         pool.run_for(0.5)
-    assert min_ordered() >= BATCH, "warm-up stalled"
+    warm_got = min_ordered()
+    assert warm_got >= target_after_shed(BATCH), "warm-up stalled"
 
+    # sheds are counted at offer() time (only their trace/metric emission
+    # waits for the drain): snapshot BEFORE the burst, or the burst's own
+    # sheds vanish from the delta and the loop waits on txns that were
+    # never admitted until the deadline
+    shed0 = pool.admission.shed_total if pool.admission else 0
     submit(txns)
-    target = BATCH + txns
+    target = warm_got + txns
     flushes0 = pool.vote_group.flushes
     deadline = time.monotonic() + 240  # fresh budget: warm-up (XLA
     # compile + flaky link) must not silently truncate the profiled run
@@ -95,12 +119,14 @@ def _run(pool, txns, profile=False):
     t0 = time.perf_counter()
     if prof:
         prof.enable()
-    while min_ordered() < target and time.monotonic() < deadline:
+    while min_ordered() < target - (
+            (pool.admission.shed_total - shed0) if pool.admission
+            else 0) and time.monotonic() < deadline:
         pool.run_for(0.5)
     if prof:
         prof.disable()
     elapsed = time.perf_counter() - t0
-    got = min_ordered() - BATCH
+    got = min_ordered() - warm_got
     dispatches = pool.vote_group.flushes - flushes0
     return got, elapsed, dispatches, prof
 
@@ -136,6 +162,11 @@ def main():
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the grouped vote plane over this many "
                          "host devices (0 = unsharded)")
+    ap.add_argument("--ingress-capacity", type=int, default=0,
+                    help="bound the auth queue (admission control): the "
+                         "profiled pool then runs the SIGNED ingress "
+                         "path and the --json record's ingress block "
+                         "carries queue depth + admitted/shed totals")
     ap.add_argument("--trace", action="store_true",
                     help="arm the consensus flight recorder: dumps the "
                          "span trace as JSONL (--trace-out) and the "
@@ -159,7 +190,8 @@ def main():
 
     pool = _build_pool(n, k, tick_interval=0.1,
                        adaptive=not args.static_tick, mesh=mesh,
-                       trace=args.trace)
+                       trace=args.trace,
+                       ingress_capacity=args.ingress_capacity)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
@@ -224,6 +256,18 @@ def main():
                      if pool.governor is not None else None),
         "hotspots_top20_cumulative": _hotspots(prof),
     }
+    # ingress plane: the admission queue's depth/admitted/shed and the
+    # read path's qps gauge, from the same pool collector every other
+    # surface reads (None when the run had no admission and no reads)
+    ingress = None
+    if pool.admission is not None:
+        ingress = pool.admission.counters()
+        ingress["shed_hash"] = pool.admission.shed_hash()
+    read_qps = pool.metrics.stat(MetricsName.READ_QPS)
+    if read_qps is not None:
+        ingress = ingress or {}
+        ingress["read_qps"] = round(read_qps.last, 1)
+    record["ingress"] = ingress
     if trace_block is not None:
         record.update(trace_block)
     if not args.no_baseline:

@@ -21,13 +21,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# persistent compile cache: the SHA-512/Ed25519 kernels cost tens of
-# seconds to compile on XLA:CPU; without this every pytest process pays
-# them again (and tests with wall-clock deadlines can eat a compile
-# mid-assertion)
-jax.config.update("jax_compilation_cache_dir",
-                  "/tmp/jax_cache_indy_plenum_tests")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+# persistent compile cache (shared with the entry-point scripts):
+# without it every pytest process re-pays the XLA:CPU kernel compiles,
+# and tests with wall-clock deadlines can eat a compile mid-assertion
+from indy_plenum_tpu.utils.jax_env import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache()
 
 import pytest  # noqa: E402
 

@@ -153,7 +153,8 @@ def bench_ed25519() -> dict:
 
 def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
                    metric: str, note: str,
-                   host_accounting: bool = False, mesh=None) -> dict:
+                   host_accounting: bool = False, mesh=None,
+                   host_eval: bool = False) -> dict:
     """Ordered txns/sec with the device quorum plane as sole authority
     (no host shadow tallies), tick-batched flushes. ``num_instances`` > 1
     runs the full RBFT instance axis — backups' tallies ride the same
@@ -191,7 +192,8 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
                    device_quorum=True, shadow_check=False,
                    num_instances=num_instances,
                    host_accounting=host_accounting,
-                   pipelined_flush=True, mesh=mesh, trace=True)
+                   pipelined_flush=True, mesh=mesh, trace=True,
+                   host_eval=host_eval)
 
     seq = 0
 
@@ -262,6 +264,19 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         # sharded sub-bench compares runs on it)
         "ordered_hash": pool.ordered_hash(),
         "shards": pool.vote_group.shards,
+        # ordering fast path (ISSUE 7): what actually crossed the
+        # device->host boundary — compact deltas ("device" eval, the
+        # default) vs the full event matrix (host_eval fallback). The
+        # next BENCH round diffs the before/after on these.
+        "eval_mode": pool.vote_group.eval_mode,
+        "readback_bytes_total": pool.vote_group.readback_bytes_total,
+        "readback_bytes_per_readback": round(
+            pool.vote_group.readback_bytes_total
+            / max(pool.vote_group.readbacks, 1), 1),
+        "readbacks": pool.vote_group.readbacks,
+        "readback_overlap_fraction": round(
+            pool.vote_group.readbacks_overlapped
+            / max(pool.vote_group.readbacks, 1), 4),
     }
     # per-phase latency attribution (VIRTUAL protocol time): which 3PC
     # phase the ordered batches spent their latency in, and which phase
@@ -1185,9 +1200,10 @@ def main() -> None:
     if extras:
         # [value, vs_baseline] (+ flush_occupancy, + the governor's
         # [tick_min, tick_median, tick_max, occupancy_ewma], + the
-        # flight recorder's per-phase share of batch latency for the
-        # tick-batched ordered sub-benches — index-based consumers keep
-        # [0]/[1])
+        # flight recorder's per-phase share of batch latency, + the
+        # readback contract's [eval_mode, bytes/readback, overlap] for
+        # the tick-batched ordered sub-benches — index-based consumers
+        # keep [0]/[1])
         def _extras_digest(e):
             row = [e["value"], e["vs_baseline"]]
             if e.get("flush_occupancy") is not None:
@@ -1199,6 +1215,12 @@ def main() -> None:
             cp = e.get("critical_path")
             if cp and cp.get("phase_share"):
                 row.append(cp["phase_share"])
+            if e.get("eval_mode") is not None:
+                # the ordering fast path's readback contract: eval mode
+                # + [bytes/readback, overlap fraction]
+                row.append([e["eval_mode"],
+                            e.get("readback_bytes_per_readback"),
+                            e.get("readback_overlap_fraction")])
             return row
 
         compact["extras"] = {e["metric"]: _extras_digest(e)

@@ -47,6 +47,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                  quorum_tick_interval: float = 0.0,
                  quorum_tick_adaptive: bool = False,
                  mesh=None,
+                 host_eval: bool = False,
                  trace: bool = False,
                  trace_out: Optional[str] = None) -> ChaosReport:
     """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
@@ -90,7 +91,8 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
     config = getConfig(overrides)
     pool = SimPool(n_nodes=n, seed=seed, config=config,
-                   device_quorum=device_quorum, mesh=mesh, trace=trace)
+                   device_quorum=device_quorum, mesh=mesh,
+                   host_eval=host_eval, trace=trace)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,
@@ -127,6 +129,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             "tick": quorum_tick_interval,
             "adaptive": quorum_tick_adaptive,
             "mesh": int(mesh.devices.size) if mesh is not None else 0,
+            "host_eval": host_eval,
             "trace": trace,
         },
         plan=plan.as_dicts(),

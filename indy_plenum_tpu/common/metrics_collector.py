@@ -59,6 +59,14 @@ class MetricsName:
     DEVICE_SHARD_COUNT = "device.shard_count"
     DEVICE_SHARD_FLUSH_VOTES = "device.shard_flush_votes"
     DEVICE_SHARD_FLUSH_CAPACITY = "device.shard_flush_capacity"
+    # ordering fast path (device-side quorum eval): bytes actually
+    # crossing the device->host boundary per absorb — O(newly certified
+    # + frontier) in device-eval mode, the full event matrix under the
+    # host_eval fallback. DEVICE_READBACK_COMPACT records the mode as a
+    # gauge (Stat.last: 1 = compact/device eval, 0 = host eval) so
+    # snapshots can label the bytes they report.
+    DEVICE_READBACK_BYTES = "device.readback_bytes"
+    DEVICE_READBACK_COMPACT = "device.readback_compact"
     # dispatch governor (adaptive tick, tpu/governor.py): the effective
     # interval after every tick (Stat.last = the CURRENT interval; the
     # histogram records how long the pool dwelt on each rung) and the

@@ -84,15 +84,33 @@ class StaticCorpusBacking:
 class LedgerBacking:
     """Committed-txn reads from a live :class:`~indy_plenum_tpu.ledger
     .ledger.Ledger`. The (size, root) snapshot is captured at
-    construction; call :meth:`refresh` after new commits to serve (and
-    prove) the newer txns — refreshing invalidates the path cache, since
-    audit paths are per-tree-size."""
+    construction and advanced on :meth:`refresh` — refreshing
+    invalidates the path cache, since audit paths are per-tree-size.
 
-    def __init__(self, ledger):
+    Pass the serving node's internal ``bus`` and the snapshot rides the
+    checkpoint-stabilized hook: every ``CheckpointStabilized`` the
+    consensus layer emits re-snapshots (size, root), so reads serve (and
+    prove) everything up to the latest stable watermark with no manual
+    refresh calls. Stabilized boundaries are exactly the roots the pool
+    has durable agreement on — refreshing mid-window would serve roots a
+    view change could still unwind."""
+
+    def __init__(self, ledger, bus=None):
         self._ledger = ledger
         self.tree_size = 0
         self.root = b""
+        self.refreshes = 0
         self._path_cache: Dict[int, List[bytes]] = {}
+        self.refresh()
+        if bus is not None:
+            from ..common.messages.internal_messages import (
+                CheckpointStabilized,
+            )
+
+            bus.subscribe(CheckpointStabilized,
+                          self._on_checkpoint_stabilized)
+
+    def _on_checkpoint_stabilized(self, msg, *args) -> None:
         self.refresh()
 
     def refresh(self) -> None:
@@ -102,6 +120,7 @@ class LedgerBacking:
         self.tree_size = size
         self.root = self._ledger.root_hash_at(size) if size else b""
         self._path_cache.clear()
+        self.refreshes += 1
 
     def leaf(self, index: int) -> bytes:
         # the ledger's tree hashed the stored serialized bytes — return

@@ -173,11 +173,20 @@ class TraceRecorder:
             fh.write(events_to_jsonl(self.tail(tail)))
         return path
 
-    def trace_hash(self) -> str:
+    def trace_hash(self, exclude_cats: Sequence[str] = ()) -> str:
         """sha256 of the JSONL serialization — THE trace fingerprint
         (seeded runs must reproduce it bit-for-bit, like
-        ``ordered_hash``)."""
-        return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
+        ``ordered_hash``). ``exclude_cats`` drops whole categories
+        before hashing: the device-eval vs host-eval identity tests
+        compare the protocol timeline (3pc/req/vc) while the dispatch
+        category legitimately differs (``flush.readback`` carries the
+        actual readback byte counts, which are the thing being
+        changed)."""
+        if not exclude_cats:
+            return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
+        drop = set(exclude_cats)
+        evs = [e for e in self.events() if e.get("cat") not in drop]
+        return hashlib.sha256(events_to_jsonl(evs).encode()).hexdigest()
 
     def clear(self) -> None:
         self._events.clear()
@@ -371,6 +380,62 @@ def critical_path(events: List[Dict[str, Any]],
         "phase_share": {p: round(totals[p] / whole, 4)
                         for p in _BREAKDOWN if p in totals} if whole
         else {},
+    }
+
+
+def overlap_report(events: List[Dict[str, Any]],
+                   node: Optional[str] = None) -> Dict[str, Any]:
+    """Per-tick host/device overlap + readback-bytes attribution (the
+    ordering fast path's measured story — ``trace_tool.py --overlap``).
+
+    A tick's dispatch events arrive in ring order as ``tick.drain``,
+    ``flush.dispatch``*, ``flush.readback``, ``tick.flush``,
+    ``tick.governor``, ``tick.eval`` — the report closes a tick at each
+    ``tick.flush`` mark and joins the trailing eval/governor marks to
+    it. ``overlapped`` on a ``flush.readback`` means the absorb consumed
+    a step DISPATCHED by an earlier flush call: its device round-trip
+    hid behind at least one full tick of host work (the pipelined
+    contract). ``readback_bytes`` is what actually crossed the
+    device->host boundary — O(newly certified + frontier) in device
+    eval, the full event matrix under host_eval."""
+    ticks: List[Dict[str, Any]] = []
+    cur = {"dispatches": 0, "votes": 0, "readbacks": 0, "overlapped": 0,
+           "readback_bytes": 0}
+    for ev in events:
+        if ev.get("cat") != "dispatch":
+            continue
+        if node is not None and ev.get("node", "") not in (node, ""):
+            continue
+        name, args = ev["name"], ev.get("args") or {}
+        if name == "flush.dispatch":
+            cur["dispatches"] += 1
+            cur["votes"] += args.get("votes", 0)
+        elif name == "flush.readback":
+            cur["readbacks"] += 1
+            cur["readback_bytes"] += args.get("bytes", 0)
+            if args.get("overlapped"):
+                cur["overlapped"] += 1
+        elif name == "tick.flush":
+            cur["ts"] = ev["ts"]
+            ticks.append(cur)
+            cur = {"dispatches": 0, "votes": 0, "readbacks": 0,
+                   "overlapped": 0, "readback_bytes": 0}
+    byte_series = sorted(t["readback_bytes"] for t in ticks)
+    readbacks = sum(t["readbacks"] for t in ticks)
+    overlapped = sum(t["overlapped"] for t in ticks)
+    return {
+        "ticks": len(ticks),
+        "readbacks": readbacks,
+        # host/device overlap fraction: readbacks whose round-trip hid
+        # behind a full tick of host work / all readbacks
+        "overlap_fraction": (round(overlapped / readbacks, 4)
+                             if readbacks else 0.0),
+        "readback_bytes_total": sum(byte_series),
+        "readback_bytes_per_tick": {
+            "p50": percentile(byte_series, 50),
+            "max": byte_series[-1] if byte_series else 0,
+        },
+        "per_tick": ticks,
     }
 
 

@@ -346,13 +346,19 @@ class OrderingService:
 
     def _note_prepare_activity(self, key: Tuple[int, int]) -> None:
         if self._tick_mode:
-            self._dirty_prepare_keys.add(key)
+            # ordering fast path: a delta-feed plane reports certificate
+            # COMPLETIONS itself (device-side quorum eval) — host-side
+            # activity tracking would re-evaluate every in-flight key
+            # every tick for nothing
+            if not self._vote_plane.delta_feed:
+                self._dirty_prepare_keys.add(key)
         else:
             self._try_prepared(key)
 
     def _note_commit_activity(self, key: Tuple[int, int]) -> None:
         if self._tick_mode:
-            self._order_dirty = True
+            if not self._vote_plane.delta_feed:
+                self._order_dirty = True
         else:
             self._try_order(key)
             if self._trace.enabled and key not in self.ordered:
@@ -390,7 +396,52 @@ class OrderingService:
         """Evaluate quorums for everything that moved since the last tick.
         The caller has already synced the vote plane; queries here (and any
         triggered by messages until the next tick) read that snapshot, so
-        votes recorded during the tick wave buffer for the next flush."""
+        votes recorded during the tick wave buffer for the next flush.
+
+        With a delta-feed plane (device-side quorum eval, the default)
+        the tick consumes the plane's newly-completed-certificate deltas
+        instead: the device already decided WHICH slots crossed their
+        thresholds this tick, so evaluation is O(completions), not
+        O(keys-with-activity) re-checked every tick until they order.
+        The lost-wakeup guard is structural there — a vote recorded
+        during this tick's wave flushes next tick and its transition
+        arrives in that tick's delta."""
+        plane = self._vote_plane
+        if plane is not None and plane.delta_feed:
+            deltas = plane.poll_deltas()
+            committed_keys: list = []
+            if deltas is not None:
+                # resolve slots -> keys BEFORE evaluating: ordering below
+                # can stabilize a checkpoint and slide the plane, and the
+                # delta slots are relative to the PRE-slide h
+                view_no, h = self._data.view_no, plane.h
+                prepared_keys = [(view_no, h + slot + 1)
+                                 for slot in deltas.prepared]
+                committed_keys = [(view_no, h + slot + 1)
+                                  for slot in deltas.committed]
+                for key in prepared_keys:
+                    self._try_prepared(key)
+                if committed_keys:
+                    self._try_order(self._data.last_ordered_3pc)
+            # dirt accumulated while the feed was not yet authoritative
+            # (plane armed mid-run) drains once; _note_* keeps it empty
+            if self._dirty_prepare_keys:
+                keys, self._dirty_prepare_keys = \
+                    self._dirty_prepare_keys, set()
+                for key in sorted(keys):
+                    self._try_prepared(key)
+            if self._order_dirty:
+                self._order_dirty = False
+                self._try_order(self._data.last_ordered_3pc)
+            if self._trace.enabled:
+                # commit quorums that can NOT order yet (head-of-line
+                # wait): the delta names exactly the quorums that became
+                # visible this tick, so no O(window) prePrepares sweep
+                for key in committed_keys:
+                    if key not in self.ordered:
+                        self._mark_commit_quorum_observed(key)
+            self._bls.flush()
+            return
         keys: set = set()
         if self._dirty_prepare_keys:
             keys, self._dirty_prepare_keys = self._dirty_prepare_keys, set()

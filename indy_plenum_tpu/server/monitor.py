@@ -172,6 +172,22 @@ class Monitor:
             # the same VotePlaneGroup.shard_occupancy number bench, the
             # budget gate and profile_rbft report, NOT an average of
             # per-dispatch ratios, which diverges once flush shapes vary)
+            # ordering fast path: what actually crosses the device->host
+            # boundary per absorb, and which eval mode produced it
+            # (compact deltas by default; the full event matrix under
+            # the host_eval differential fallback)
+            rb = self._metrics.stat(MetricsName.DEVICE_READBACK_BYTES)
+            if rb is not None:
+                device["readback"] = {
+                    "bytes_total": int(rb.total),
+                    "bytes_per_readback": round(rb.avg, 1),
+                    "readbacks": rb.count,
+                }
+                mode = self._metrics.stat(
+                    MetricsName.DEVICE_READBACK_COMPACT)
+                if mode is not None:
+                    device["eval_mode"] = ("device" if mode.last
+                                           else "host")
             shard_count = self._metrics.stat(MetricsName.DEVICE_SHARD_COUNT)
             if shard_count is not None and shard_count.last:
                 n_shards = int(shard_count.last)

@@ -459,12 +459,25 @@ class Node:
             signal = self._flush_auth_queue()
         plane = self.vote_plane
         before = (plane.flushes, plane.flush_votes_total,
-                  plane.flush_capacity_total)
+                  plane.flush_capacity_total, plane.readback_bytes_total)
         plane.sync()
         dispatches = plane.flushes - before[0]
         self.metrics.add_event(MetricsName.DEVICE_DISPATCHES_PER_TICK,
                                dispatches)
+        # ordering fast path: the tick's actual device->host transfer —
+        # O(newly certified + frontier) in device-eval mode, the full
+        # event matrix under the host_eval fallback
+        readback_bytes = plane.readback_bytes_total - before[3]
+        self.metrics.add_event(MetricsName.DEVICE_READBACK_BYTES,
+                               readback_bytes)
+        self.metrics.add_event(MetricsName.DEVICE_READBACK_COMPACT,
+                               0 if plane.host_eval else 1)
         if trace_on:
+            # ring order matters: overlap_report closes a tick bucket at
+            # each tick.flush mark, so the readback must precede it
+            self.trace.record(
+                "flush.readback", cat="dispatch", node=self.name,
+                args={"bytes": readback_bytes, "overlapped": False})
             self.trace.record(
                 "tick.flush", cat="dispatch", node=self.name,
                 args={"dispatches": dispatches,
@@ -477,7 +490,8 @@ class Node:
             self._quorum_tick_timer.update_interval(
                 self._dispatch_governor.observe(
                     plane.flush_votes_total - before[1],
-                    plane.flush_capacity_total - before[2], dispatches))
+                    plane.flush_capacity_total - before[2], dispatches,
+                    inflight=plane.lagging))
             if trace_on:
                 self.trace.record(
                     "tick.governor", cat="dispatch", node=self.name,

@@ -32,6 +32,7 @@ class NodePool:
                  num_instances: int = 1,
                  with_pool_genesis: bool = False,
                  mesh=None,
+                 host_eval: bool = False,
                  trace: bool = False):
         # num_instances: 1 = master only; 0 = auto f+1 (full RBFT)
         # mesh: shard the grouped vote plane's (node x instance) member
@@ -114,7 +115,7 @@ class NodePool:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
                 num_instances=resolved_instances, mesh=mesh,
-                metrics=self.metrics)
+                metrics=self.metrics, host_eval=host_eval)
             self.vote_group.trace = self.trace
 
         tick_mode = self.config.QuorumTickInterval > 0

@@ -356,7 +356,8 @@ class SimPool:
                  num_instances: int = 1,
                  mesh=None,
                  host_accounting: bool = False,
-                 pipelined_flush: bool = False,
+                 pipelined_flush: bool = True,
+                 host_eval: bool = False,
                  spy: bool = False,
                  trace: bool = False,
                  trace_capacity: Optional[int] = None):
@@ -443,7 +444,8 @@ class SimPool:
             self.vote_group = make_vote_group(
                 n_nodes, self.validators, self.config,
                 num_instances=num_instances, mesh=mesh,
-                pipelined=pipelined_flush, metrics=self.metrics)
+                pipelined=pipelined_flush, metrics=self.metrics,
+                host_eval=host_eval)
             self.vote_group.trace = self.trace
 
         k = num_instances

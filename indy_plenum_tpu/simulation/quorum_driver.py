@@ -34,15 +34,20 @@ from ..observability.trace import _NO_SPAN
 
 def make_vote_group(n_nodes: int, validators, config: Config,
                     num_instances: int = 1, mesh=None,
-                    pipelined: bool = False, metrics=None):
+                    pipelined: bool = True, metrics=None,
+                    host_eval: bool = False):
     """Member axis = (node x instance): member i*num_instances + inst_id
     is node i's plane for protocol instance inst_id (SURVEY §2.6's RBFT
     mapping — instances are a leading tensor dimension, so backups' vote
     tallies ride the same vmapped dispatch as the master's). ``mesh``
     shards that member axis across a device mesh via ``shard_map`` (the
     member count is padded up to a mesh multiple; quorum events gather
-    back in one readback); ``pipelined`` overlaps each tick's device
-    round-trip with the next tick's host work (verdicts lag one tick).
+    back in one readback); ``pipelined`` (DEFAULT since the ordering
+    fast path: README "Performance") overlaps each tick's device
+    round-trip with the next tick's host work (verdicts lag one tick;
+    the services' lost-wakeup guard re-arms while a step is in flight).
+    ``host_eval`` selects the full-event-matrix readback fallback over
+    the default on-device quorum eval + compact delta readback.
     ``config.FlushLadderAdaptive`` hands the padded flush width to the
     learned per-pool ladder."""
     from ..tpu.vote_plane import VotePlaneGroup
@@ -52,7 +57,8 @@ def make_vote_group(n_nodes: int, validators, config: Config,
         log_size=config.LOG_SIZE,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
         mesh=mesh, pipelined=pipelined, metrics=metrics,
-        adaptive_ladder=config.FlushLadderAdaptive)
+        adaptive_ladder=config.FlushLadderAdaptive,
+        host_eval=host_eval)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
@@ -139,7 +145,12 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                                        last_shard[0])],
                 [a - b for a, b in zip(vote_group.flush_capacity_per_shard,
                                        last_shard[1])],
-                dispatches)
+                dispatches,
+                # pipelined plane with verdicts in flight: cap the next
+                # tick at the base interval so the absorb is prompt (the
+                # absorb tick dispatches nothing — see the governor's
+                # absorb clamp)
+                inflight=vote_group.lagging)
             timer_box[0].update_interval(new_interval)
             if trace.enabled:
                 trace.record(

@@ -72,6 +72,8 @@ def make_sharded_fused_step(
         commit_votes=P(axis, None),
         checkpoint_votes=P(axis, None),
         ordered=P(),
+        prepared_acked=P(),
+        frontier=P(),
     )
     replicated_msgs = q.MsgBatch(kind=P(), sender=P(), slot=P(), valid=P())
     batch_sharded = P(axis, None)

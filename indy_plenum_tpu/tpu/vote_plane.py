@@ -44,7 +44,7 @@ series feeding the dispatch governor's hottest-shard law.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 
@@ -134,6 +134,19 @@ class AdaptiveLadder:
         return pow2_rung(n_votes)
 
 
+class PlaneDeltas(NamedTuple):
+    """One member's accumulated device-eval deltas since the last poll:
+    ascending h-relative slots whose prepare / commit certificates newly
+    completed, plus the member's current in-order ordering frontier
+    (``h + frontier`` is the highest contiguously commit-certified
+    seqNo). Consumed by ``OrderingService.service_quorum_tick`` instead
+    of rescanning host snapshots."""
+
+    prepared: List[int]
+    committed: List[int]
+    frontier: int
+
+
 # double-buffered device steps: donate the state operand so XLA writes
 # the step's output state INTO the input's buffers (no state-sized
 # alloc+copy per dispatch) while the freshly packed words ride their own
@@ -190,6 +203,11 @@ def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
         checkpoint_votes=jnp.where(delta > 0, 0,
                                    state.checkpoint_votes),
         ordered=roll1(state.ordered),
+        prepared_acked=roll1(state.prepared_acked),
+        # the in-order frontier slides with the window (host mirrors
+        # apply the identical clamp so device and host never disagree)
+        frontier=jnp.maximum(
+            state.frontier - delta, 0).astype(jnp.int32),
     )
 
 
@@ -203,24 +221,52 @@ def _group_step(states: q.VoteState, msgs: q.MsgBatch, n_validators: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _jit_group_step_words():
+def _jit_step_words_compact():
     return functools.partial(
-        jax.jit, static_argnums=(2,),
-        donate_argnums=_state_donation())(_group_step_words_impl)
+        jax.jit, static_argnums=(2, 3),
+        donate_argnums=_state_donation())(_step_words_compact_impl)
 
 
-def _group_step_words_impl(states: q.VoteState, words, n_validators: int):
+def _step_words_compact_impl(state: q.VoteState, words, n_validators: int,
+                             delta_cap: int):
+    return q.step_compact(state, q.unpack_words(words), n_validators,
+                          delta_cap)
+
+
+def _step_words_compact(state: q.VoteState, words, n_validators: int,
+                        delta_cap: int):
+    """Single-plane ordering fast path: the standalone (deployed-Node)
+    analog of :func:`_group_step_compact` — quorum eval + frontier
+    advance on device, compact deltas read back."""
+    return _jit_step_words_compact()(state, words, n_validators, delta_cap)
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_group_step_compact():
+    return functools.partial(
+        jax.jit, static_argnums=(2, 3),
+        donate_argnums=_state_donation())(_group_step_compact_impl)
+
+
+def _group_step_compact_impl(states: q.VoteState, words, n_validators: int,
+                             delta_cap: int):
     msgs = q.unpack_words(words)
-    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+    return jax.vmap(
+        lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
+    )(states, msgs)
 
 
-def _group_step_words(states: q.VoteState, words, n_validators: int):
-    """Group step over word-packed votes: the (M, B) uint32 operand is a
-    quarter the bytes of a MsgBatch — the host->device transfer is the
-    blocking cost of a flush, so this is the wire format for groups. The
-    states operand is donated (see _state_donation): tick N's output
-    state lands in tick N-1's buffers while the host packs tick N+1."""
-    return _jit_group_step_words()(states, words, n_validators)
+def _group_step_compact(states: q.VoteState, words, n_validators: int,
+                        delta_cap: int):
+    """The ordering fast path's group step: ONE dispatch scatters every
+    member's votes, folds counts into quorum verdicts, advances each
+    member's in-order frontier ON DEVICE and emits per-member
+    :class:`~indy_plenum_tpu.tpu.quorum.CompactEvents` — what the host
+    reads back is O(newly certified + frontier), not the (M, N, S) event
+    matrix. Full events are also returned but stay device-resident
+    unless the host explicitly fetches them (overflow / host_eval /
+    diagnostics)."""
+    return _jit_group_step_compact()(states, words, n_validators, delta_cap)
 
 
 @jax.jit
@@ -234,7 +280,8 @@ def _group_zero_member(states: q.VoteState, member: jnp.ndarray) -> q.VoteState:
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_group_fns(mesh, axis: str, n_validators: int):
+def _sharded_group_fns(mesh, axis: str, n_validators: int,
+                       delta_cap: int = q.ORDER_DELTA_CAP):
     """shard_map'd (step, slide, zero) for a member-sharded group.
 
     The member axis M is split across ``mesh``; inside each shard the
@@ -246,21 +293,25 @@ def _sharded_group_fns(mesh, axis: str, n_validators: int):
     grouped dispatch can never silently fall back to an all-gather.
 
     The step is jitted with the state operand donated (same PR 3
-    double-buffer contract as the unsharded `_group_step_words`, gated
+    double-buffer contract as the unsharded `_group_step_compact`, gated
     off XLA:CPU) and ``zero`` takes an (M,) member MASK instead of a
     scalar index — a dynamic row index cannot be resolved against a
     shard-local block, a mask shards trivially.
     """
     state_spec, row_spec, events_spec, vec_spec = q.member_sharded_specs(axis)
+    compact_spec = q.compact_member_specs(axis)
 
     def step_impl(states, words):
         msgs = q.unpack_words(words)
-        return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+        return jax.vmap(
+            lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
+        )(states, msgs)
 
     step = functools.partial(jax.jit, donate_argnums=_state_donation())(
         q.shard_map_compat(step_impl, mesh=mesh,
                            in_specs=(state_spec, row_spec),
-                           out_specs=(state_spec, events_spec)))
+                           out_specs=(state_spec, events_spec,
+                                      compact_spec)))
 
     def slide_impl(states, deltas):
         return jax.vmap(_slide_core)(states, deltas)
@@ -284,16 +335,28 @@ def _sharded_group_fns(mesh, axis: str, n_validators: int):
 
 
 class DeviceVotePlane:
-    """Per-instance device vote tensors + lazy flush/query interface."""
+    """Per-instance device vote tensors + lazy flush/query interface.
+
+    ``host_eval`` selects the readback mode (the ordering fast path):
+    False (default) runs :func:`~indy_plenum_tpu.tpu.quorum.step_compact`
+    — quorum verdicts and the in-order frontier are computed ON DEVICE
+    and each flush reads back only the compact deltas, folded into host
+    mirror planes; the plane then feeds ``poll_deltas``. True keeps the
+    full event-matrix readback (differential-testing fallback). Both
+    modes dispatch the identical device-step sequence."""
 
     def __init__(self, validators: List[str], log_size: int,
-                 n_checkpoints: int = 4, h: int = 0):
+                 n_checkpoints: int = 4, h: int = 0,
+                 host_eval: bool = False,
+                 delta_cap: Optional[int] = None):
         self._validators = list(validators)
         self._index = {name: i for i, name in enumerate(self._validators)}
         self._n = len(self._validators)
         self._log_size = log_size
         self._n_chk = n_checkpoints
         self._h = h
+        self.host_eval = host_eval
+        self._delta_cap = int(delta_cap) if delta_cap else q.ORDER_DELTA_CAP
         self._state = q.init_state(self._n, log_size, n_checkpoints)
         self._pending: List[int] = []  # uint32 vote words (q.pack_vote)
         self._events: Optional[q.QuorumEvents] = None
@@ -302,8 +365,21 @@ class DeviceVotePlane:
         self._host_prepared: Optional[np.ndarray] = None
         self._host_prepare_counts: Optional[np.ndarray] = None
         self._host_commit_counts: Optional[np.ndarray] = None
+        self._host_commit_ok: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
+        # device-eval mirrors (see VotePlaneGroup): incrementally
+        # maintained from each step's CompactEvents deltas
+        self._mir_prepared = np.zeros(log_size, bool)
+        self._mir_commit_ok = np.zeros(log_size, bool)
+        self._mir_stable = np.zeros(n_checkpoints, bool)
+        self._mir_frontier = 0
+        self._delta_prepared: List[int] = []
+        self._delta_committed: List[int] = []
         self.flushes = 0
+        # device->host transfer accounting (the fast path's contract is
+        # measured in these, not asserted in prose)
+        self.readback_bytes_total = 0
+        self.readbacks = 0
         # cumulative scattered votes and padded scatter capacity: the
         # occupancy signal the dispatch governor closes its loop over
         # (per-tick deltas of these two counters)
@@ -394,10 +470,39 @@ class DeviceVotePlane:
         if new_h <= self._h:
             return
         self._flush()
-        self._state = _slide(self._state, jnp.int32(new_h - self._h))
+        delta = new_h - self._h
+        self._state = _slide(self._state, jnp.int32(delta))
         self._h = new_h
         self._events = None
         self._host_prepared = None  # snapshot is void, even in defer mode
+        self._roll_mirrors(delta)
+
+    def _roll_mirrors(self, delta: int) -> None:
+        """Mirror the device's window slide host-side: roll the eval
+        mirrors left by ``delta``, clamp the frontier, re-base the
+        unpolled delta slots (slots below the new h are stabilized —
+        their consumers are done with them)."""
+        s = self._log_size
+        for mir in (self._mir_prepared, self._mir_commit_ok):
+            if delta < s:
+                mir[:s - delta] = mir[delta:]
+                mir[s - delta:] = False
+            else:
+                mir[:] = False
+        self._mir_stable[:] = False
+        self._mir_frontier = max(self._mir_frontier - delta, 0)
+        self._delta_prepared = [
+            x - delta for x in self._delta_prepared if x >= delta]
+        self._delta_committed = [
+            x - delta for x in self._delta_committed if x >= delta]
+
+    def _zero_mirrors(self) -> None:
+        self._mir_prepared[:] = False
+        self._mir_commit_ok[:] = False
+        self._mir_stable[:] = False
+        self._mir_frontier = 0
+        self._delta_prepared = []
+        self._delta_committed = []
 
     def reset(self, h: Optional[int] = None) -> None:
         """View change: clear all votes (they were for the old view)."""
@@ -407,8 +512,53 @@ class DeviceVotePlane:
         self._pending.clear()
         self._events = None
         self._host_prepared = None  # snapshot is void, even in defer mode
+        self._zero_mirrors()
 
     # --- flush + queries ------------------------------------------------
+
+    def _step_chunk(self, words) -> None:
+        """One device step over a padded word row; in device-eval mode
+        the compact deltas are folded into the mirrors immediately."""
+        if self.host_eval:
+            self._state, self._events = _step_words(
+                self._state, words, self._n)
+            return
+        self._state, self._events, compact = _step_words_compact(
+            self._state, words, self._n, self._delta_cap)
+        self._apply_compact_single(compact)
+
+    def _apply_compact_single(self, compact: "q.CompactEvents") -> None:
+        """Fetch one step's compact deltas and fold them into the single-
+        plane mirrors + delta accumulators (the standalone analog of
+        VotePlaneGroup._apply_compact, same overflow fallback)."""
+        host = jax.device_get(compact)
+        bytes_n = sum(np.asarray(a).nbytes for a in host)
+        s = self._log_size
+        if int(host.n_prepared) > self._delta_cap:
+            full = jax.device_get(self._events.prepared)
+            bytes_n += full.nbytes
+            new_p = np.nonzero(np.asarray(full, bool)
+                               & ~self._mir_prepared)[0]
+        else:
+            row = np.asarray(host.new_prepared)
+            new_p = row[row < s]
+        if int(host.n_committed) > self._delta_cap:
+            full = jax.device_get(self._events.ordered)
+            bytes_n += full.nbytes
+            new_c = np.nonzero(np.asarray(full, bool)
+                               & ~self._mir_commit_ok)[0]
+        else:
+            row = np.asarray(host.new_committed)
+            new_c = row[row < s]
+        if new_p.size:
+            self._mir_prepared[new_p] = True
+            self._delta_prepared.extend(int(x) for x in new_p)
+        if new_c.size:
+            self._mir_commit_ok[new_c] = True
+            self._delta_committed.extend(int(x) for x in new_c)
+        np.copyto(self._mir_stable, np.asarray(host.stable, bool))
+        self._mir_frontier = int(host.frontier)
+        self.readback_bytes_total += bytes_n
 
     def _flush(self) -> None:
         while self._pending:
@@ -416,8 +566,7 @@ class DeviceVotePlane:
                                     self._pending[FLUSH_BATCH:])
             shape = ladder_shape(len(chunk))
             words = jnp.asarray(q.words_row(chunk, shape))
-            self._state, self._events = _step_words(
-                self._state, words, self._n)
+            self._step_chunk(words)
             self.flushes += 1
             self.flush_votes_total += len(chunk)
             self.flush_capacity_total += shape
@@ -425,18 +574,34 @@ class DeviceVotePlane:
     def _refresh(self) -> None:
         self._flush()
         if self._events is None:  # nothing ever recorded
-            self._state, self._events = _step_words(
-                self._state, jnp.asarray(q.words_row([], FLUSH_LADDER[0])),
-                self._n)
+            self._step_chunk(
+                jnp.asarray(q.words_row([], FLUSH_LADDER[0])))
             # a real device dispatch: count it like any other flush, or
             # the governor (and the dispatch budget) would see a post-
             # reset tick as free
             self.flushes += 1
             self.flush_capacity_total += FLUSH_LADDER[0]
+        if not self.host_eval:
+            # compact absorption already happened per step in _flush;
+            # the snapshot IS the mirrors (counts stay device-resident)
+            self._host_prepared = self._mir_prepared
+            self._host_commit_ok = self._mir_commit_ok
+            self._host_stable = self._mir_stable
+            self._host_prepare_counts = None
+            self._host_commit_counts = None
+            self.readbacks += 1
+            return
         (self._host_prepared, self._host_prepare_counts,
          self._host_commit_counts, self._host_stable) = jax.device_get(
             (self._events.prepared, self._events.prepare_counts,
              self._events.commit_counts, self._events.stable_checkpoints))
+        self._host_commit_ok = (
+            self._host_commit_counts >= self._n - (self._n - 1) // 3)
+        self.readback_bytes_total += sum(
+            a.nbytes for a in (self._host_prepared,
+                               self._host_prepare_counts,
+                               self._host_commit_counts, self._host_stable))
+        self.readbacks += 1
 
     def sync(self) -> None:
         """Flush all buffered votes and refresh the host snapshot (the
@@ -463,15 +628,50 @@ class DeviceVotePlane:
         if slot is None:
             return False
         self.events()
-        f = (self._n - 1) // 3
-        return int(self._host_commit_counts[slot]) >= self._n - f
+        return bool(self._host_commit_ok[slot])
+
+    # the ordering fast path (device-side quorum eval): a plane that
+    # feeds newly-certified deltas advertises delta_feed and serves
+    # poll_deltas(); in host_eval mode services fall back to snapshot
+    # re-scans (differential testing)
+    @property
+    def delta_feed(self) -> bool:
+        return not self.host_eval
+
+    @property
+    def lagging(self) -> bool:
+        """The standalone plane syncs synchronously — never a dispatched
+        step awaiting absorb (the pipelined group overrides this; the
+        governor's absorb clamp keys on it)."""
+        return False
+
+    def poll_deltas(self) -> Optional[PlaneDeltas]:
+        """Drain the accumulated device-eval deltas (ascending h-relative
+        slots whose prepare/commit certs newly completed since the last
+        poll) + the current in-order frontier. Consumed once; None in
+        host_eval mode AND on quiet polls (nothing completed — the
+        common case for most members most ticks, kept allocation-free)."""
+        if self.host_eval:
+            return None
+        if not self._delta_prepared and not self._delta_committed:
+            return None
+        prepared, self._delta_prepared = self._delta_prepared, []
+        committed, self._delta_committed = self._delta_committed, []
+        return PlaneDeltas(sorted(prepared), sorted(committed),
+                           int(self._mir_frontier))
 
     def prepare_count(self, pp_seq_no: int) -> int:
         slot = self._slot(pp_seq_no)
         if slot is None:
             return 0
         self.events()
-        return int(self._host_prepare_counts[slot])
+        if self._host_prepare_counts is not None:
+            return int(self._host_prepare_counts[slot])
+        # device-eval mode keeps counts device-resident; fetch the one
+        # scalar on demand (diagnostics path, never the tick loop)
+        if self._events is None:
+            return 0
+        return int(jax.device_get(self._events.prepare_counts[slot]))
 
 
 class VotePlaneGroup:
@@ -488,7 +688,9 @@ class VotePlaneGroup:
     def __init__(self, n_members: int, validators: List[str], log_size: int,
                  n_checkpoints: int = 4, h: int = 0, metrics=None,
                  mesh=None, pipelined: bool = False,
-                 adaptive_ladder: bool = False):
+                 adaptive_ladder: bool = False,
+                 host_eval: bool = False,
+                 delta_cap: Optional[int] = None):
         """``mesh``: an optional :class:`jax.sharding.Mesh` with one axis;
         the member axis of every vote tensor is sharded across it via
         ``q.shard_map_compat``, so one pod's chips split the pool's
@@ -500,10 +702,26 @@ class VotePlaneGroup:
         occupancy accounting excludes them, so a 10-member pool on an
         8-device mesh costs two idle rows, not a ValueError.
         ``adaptive_ladder`` hands the padded flush width to an
-        :class:`AdaptiveLadder` (learned per-pool top rung)."""
+        :class:`AdaptiveLadder` (learned per-pool top rung).
+
+        ``host_eval`` selects the readback/eval mode. False (the
+        default, the ordering fast path): quorum decisions are made ON
+        DEVICE (:func:`~indy_plenum_tpu.tpu.quorum.step_compact` —
+        prepare/commit certificates, in-order frontier) and each
+        dispatch reads back only its :class:`~indy_plenum_tpu.tpu
+        .quorum.CompactEvents` deltas, which the group folds into
+        incrementally-maintained host mirror planes; members additionally
+        accumulate the deltas for ``poll_deltas``. True (the
+        differential-testing fallback): the full (M, S) event matrix is
+        fetched per flush exactly as before. Both modes dispatch the
+        IDENTICAL device-step sequence — only the bytes crossing the
+        link differ — so seeded runs order bit-identical digests either
+        way (``check_dispatch_budget.py``'s readback gate)."""
         self._n = len(validators)
         self._log_size = log_size
         self._n_chk = n_checkpoints
+        self.host_eval = host_eval
+        self._delta_cap = int(delta_cap) if delta_cap else q.ORDER_DELTA_CAP
         proto = q.init_state(self._n, log_size, n_checkpoints)
         self._mesh = mesh
         self._sharding = None
@@ -521,7 +739,8 @@ class VotePlaneGroup:
             # member axis sharded; everything below it stays local
             self._sharding = lambda ndim: NamedSharding(
                 mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
-            self._sharded_fns = _sharded_group_fns(mesh, axis, self._n)
+            self._sharded_fns = _sharded_group_fns(mesh, axis, self._n,
+                                                   self._delta_cap)
             # shard index -> owning device, resolved ONCE from the
             # sharding's own index map (the row-block assignment is
             # static per mesh; _stage_scatter must not recompute it —
@@ -547,10 +766,34 @@ class VotePlaneGroup:
             _MemberPlane(self, i, validators, log_size, n_checkpoints, h)
             for i in range(n_members)]
         self.version = 0  # bumped on every device-state change
+        # host snapshot. In BOTH modes `_host_prepared is None` means
+        # "snapshot void" (cold start / post-slide / post-reset) and
+        # drives the same empty-dispatch branches — the dispatch sequence
+        # must never depend on the eval mode. In device-eval mode the
+        # snapshot arrays point at the incrementally-maintained mirrors
+        # below; in host_eval mode at the last fetched event matrix.
         self._host_prepared: Optional[np.ndarray] = None
         self._host_prepare_counts: Optional[np.ndarray] = None
         self._host_commit_counts: Optional[np.ndarray] = None
+        self._host_commit_ok: Optional[np.ndarray] = None
         self._host_stable: Optional[np.ndarray] = None
+        # device-eval mirrors: (M, S)/(M, C) boolean planes kept current
+        # by folding each dispatch's CompactEvents deltas in — the host
+        # never re-fetches what it already knows
+        self._mir_prepared = np.zeros((self._m_pad, log_size), bool)
+        self._mir_commit_ok = np.zeros((self._m_pad, log_size), bool)
+        self._mir_stable = np.zeros((self._m_pad, n_checkpoints), bool)
+        self._mir_frontier = np.zeros(self._m_pad, np.int64)
+        # last absorbed step's device-resident full events: the overflow
+        # fallback + on-demand diagnostics (prepare_count) read from it
+        self._dev_events: Optional[q.QuorumEvents] = None
+        # readback accounting: bytes actually crossing the device->host
+        # boundary per absorb, and how many absorbs were overlapped
+        # (consumed a step dispatched by an EARLIER flush call)
+        self.readback_bytes_total = 0
+        self.readbacks = 0
+        self.readbacks_overlapped = 0
+        self._flush_seq = 0
         self.flushes = 0
         # occupancy counters (see DeviceVotePlane): per-tick deltas feed
         # the dispatch governor
@@ -592,7 +835,11 @@ class VotePlaneGroup:
         # verdicts lag one extra tick (votes are never lost; the services'
         # lost-wakeup guard re-arms while a step is in flight).
         self.pipelined = pipelined
-        self._inflight: Optional[q.QuorumEvents] = None
+        # in-flight steps: list of (events, compact) per chained dispatch
+        # of the last flush, plus the flush seq that dispatched them
+        # (overlap attribution)
+        self._inflight: Optional[list] = None
+        self._inflight_seq = 0
 
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
@@ -611,15 +858,111 @@ class VotePlaneGroup:
                 for v, c in zip(self.flush_votes_per_shard,
                                 self.flush_capacity_per_shard)]
 
-    def _absorb(self, events: q.QuorumEvents) -> None:
-        """ONE bundled device->host transfer into the host snapshot."""
-        with self.trace.span("flush.readback") if self.trace.enabled \
-                else _NO_SPAN:
-            (self._host_prepared, self._host_prepare_counts,
-             self._host_commit_counts, self._host_stable) = jax.device_get(
-                (events.prepared, events.prepare_counts,
-                 events.commit_counts, events.stable_checkpoints))
+    @property
+    def eval_mode(self) -> str:
+        """Where quorum decisions are made: "device" (compact readback,
+        the default) or "host" (full event-matrix readback fallback)."""
+        return "host" if self.host_eval else "device"
+
+    def _absorb_results(self, results: list, overlapped: bool) -> None:
+        """Fold one flush's chained steps into the host snapshot.
+
+        host_eval mode: ONE bundled full-matrix transfer (the last
+        chained step's events are cumulative). Device-eval mode: each
+        step's CompactEvents deltas are fetched and folded into the
+        mirrors — O(newly certified + frontier) bytes, with a full-
+        events fallback only for a member whose per-step delta
+        overflowed the fixed capacity. The ``flush.readback`` span's
+        ``bytes`` arg is the fast path's acceptance contract."""
+        args = ({"bytes": 0, "overlapped": overlapped}
+                if self.trace.enabled else None)
+        with self.trace.span("flush.readback", args=args) \
+                if self.trace.enabled else _NO_SPAN:
+            if self.host_eval:
+                events = results[-1][0]
+                (self._host_prepared, self._host_prepare_counts,
+                 self._host_commit_counts,
+                 self._host_stable) = jax.device_get(
+                    (events.prepared, events.prepare_counts,
+                     events.commit_counts, events.stable_checkpoints))
+                self._host_commit_ok = (
+                    self._host_commit_counts
+                    >= self._n - (self._n - 1) // 3)
+                bytes_n = sum(a.nbytes for a in (
+                    self._host_prepared, self._host_prepare_counts,
+                    self._host_commit_counts, self._host_stable))
+            else:
+                bytes_n = 0
+                for events, compact in results:
+                    bytes_n += self._apply_compact(events, compact)
+                self._host_prepared = self._mir_prepared
+                self._host_commit_ok = self._mir_commit_ok
+                self._host_stable = self._mir_stable
+                self._host_prepare_counts = None
+                self._host_commit_counts = None
+            if args is not None:
+                args["bytes"] = bytes_n
+        self._dev_events = results[-1][0]
+        self.readback_bytes_total += bytes_n
+        self.readbacks += 1
+        if overlapped:
+            self.readbacks_overlapped += 1
+        self.metrics.add_event(MetricsName.DEVICE_READBACK_BYTES, bytes_n)
+        self.metrics.add_event(MetricsName.DEVICE_READBACK_COMPACT,
+                               0 if self.host_eval else 1)
         self.version += 1
+
+    def _apply_compact(self, events: q.QuorumEvents,
+                       compact: "q.CompactEvents") -> int:
+        """Fetch ONE step's compact deltas and fold them into the
+        mirrors + per-member delta accumulators; returns the bytes that
+        crossed the link. A member whose true delta count exceeds the
+        fixed capacity triggers one full-events fetch for this step and
+        reconciles by diffing against its mirror — same result, bigger
+        readback, deterministic (overflow is a pure function of the
+        seeded vote trajectory)."""
+        host = jax.device_get(compact)
+        bytes_n = sum(a.nbytes for a in host)
+        s = self._log_size
+        cap = self._delta_cap
+        members = self._members
+        n_real = len(members)
+        over_p = host.n_prepared > cap
+        over_c = host.n_committed > cap
+        full_prep = full_ord = None
+        if over_p.any() or over_c.any():
+            full_prep, full_ord = jax.device_get(
+                (events.prepared, events.ordered))
+            bytes_n += full_prep.nbytes + full_ord.nbytes
+        # rows with anything to fold: slot lists are ascending and
+        # S-padded, so row[0] < S iff the row is non-empty
+        touched = np.nonzero(
+            (host.new_prepared[:n_real, 0] < s)
+            | (host.new_committed[:n_real, 0] < s)
+            | over_p[:n_real] | over_c[:n_real])[0]
+        for mi in touched:
+            member = members[mi]
+            if over_p[mi]:
+                new = np.nonzero(full_prep[mi]
+                                 & ~self._mir_prepared[mi])[0]
+            else:
+                row = host.new_prepared[mi]
+                new = row[row < s]
+            if new.size:
+                self._mir_prepared[mi, new] = True
+                member._delta_prepared.extend(int(x) for x in new)
+            if over_c[mi]:
+                new = np.nonzero(full_ord[mi]
+                                 & ~self._mir_commit_ok[mi])[0]
+            else:
+                row = host.new_committed[mi]
+                new = row[row < s]
+            if new.size:
+                self._mir_commit_ok[mi, new] = True
+                member._delta_committed.extend(int(x) for x in new)
+        np.copyto(self._mir_stable, host.stable.astype(bool))
+        self._mir_frontier[:] = host.frontier
+        return bytes_n
 
     @property
     def lagging(self) -> bool:
@@ -669,16 +1012,21 @@ class VotePlaneGroup:
 
     def _run_group_step(self, words):
         """ONE grouped device step over the whole (padded) member axis —
-        shard_map'd under a mesh, plain vmapped jit otherwise."""
+        shard_map'd under a mesh, plain vmapped jit otherwise. Returns
+        (new_states, events, compact): quorum eval AND the in-order
+        frontier advance happen inside this dispatch (the ordering fast
+        path), in both modes — host_eval only changes what gets read
+        back, never what the device computes."""
         if self._sharded_fns is not None:
             return self._sharded_fns[0](self._states, words)
-        return _group_step_words(self._states, words, self._n)
+        return _group_step_compact(self._states, words, self._n,
+                                   self._delta_cap)
 
     def _dispatch_pending(self):
         """Chunk + scatter every member's pending votes (async dispatch);
-        returns the LAST chained step's events (they reflect every vote
-        dispatched here), or None if nothing was pending."""
-        events = None
+        returns the list of chained (events, compact) step results, empty
+        if nothing was pending."""
+        results = []
         while any(m._pending for m in self._members):
             chunks = []
             votes = 0
@@ -705,7 +1053,8 @@ class VotePlaneGroup:
                     args={"votes": votes, "shape": shape}) \
                     if self.trace.enabled else _NO_SPAN:
                 words = self._stage_scatter(chunks, shape)
-                self._states, events = self._run_group_step(words)
+                self._states, events, compact = self._run_group_step(words)
+            results.append((events, compact))
             self.flushes += 1
             capacity = len(self._members) * shape
             self.flush_votes_total += votes
@@ -715,7 +1064,7 @@ class VotePlaneGroup:
             self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
             self.metrics.add_event(
                 MetricsName.DEVICE_FLUSH_OCCUPANCY, votes / capacity)
-        return events
+        return results
 
     def _account_shards(self, shard_votes: List[int], shape: int) -> None:
         """Fold one dispatch into the per-shard occupancy series (the
@@ -742,40 +1091,51 @@ class VotePlaneGroup:
         """One padded no-vote step (cold start needs SOME events)."""
         words = self._stage_scatter(
             [[] for _ in self._members], FLUSH_LADDER[0])
-        self._states, events = self._run_group_step(words)
+        self._states, events, compact = self._run_group_step(words)
         self.flushes += 1
         self.flush_capacity_total += len(self._members) * FLUSH_LADDER[0]
         self._account_shards([0] * self._n_shards, FLUSH_LADDER[0])
         self.metrics.add_event(MetricsName.DEVICE_FLUSH)
-        return events
+        return [(events, compact)]
+
+    def _readback_arrays(self, events, compact):
+        """The arrays an absorb of this step will fetch — what the
+        pipelined path warms with copy_to_host_async so next tick's
+        absorb finds the bytes already host-side."""
+        if self.host_eval:
+            return (events.prepared, events.prepare_counts,
+                    events.commit_counts, events.stable_checkpoints)
+        return tuple(compact)
 
     def _flush_pipelined(self) -> None:
-        # 1. absorb the step dispatched LAST tick (usually complete by
-        # now: the whole tick's host work overlapped its round-trip)
+        # 1. absorb the steps dispatched LAST tick (usually complete by
+        # now: the whole tick's host work overlapped their round-trip)
         self._sync_inflight()
-        # 2. dispatch this tick's votes; events ride to the host next tick
-        events = self._dispatch_pending()
-        if events is not None:
-            # the LAST chained step's events reflect every vote above.
-            # Kick the device->host copy off NOW: by the time next tick's
-            # absorb runs, the bytes are already host-side and device_get
-            # returns without a link round-trip (measured: the blocking
-            # cost of a flush drops to ~0 on a remote device link).
-            for arr in (events.prepared, events.prepare_counts,
-                        events.commit_counts, events.stable_checkpoints):
-                try:
-                    arr.copy_to_host_async()
-                except Exception:  # noqa: BLE001 — backends without async
-                    break  # copy: device_get pays the round-trip as before
-            self._inflight = events
+        # 2. dispatch this tick's votes; results ride to the host next
+        # tick. Kick the device->host copies off NOW: by the time next
+        # tick's absorb runs, the bytes are already host-side and
+        # device_get returns without a link round-trip — and on the fast
+        # path those bytes are the compact deltas, not the event matrix.
+        results = self._dispatch_pending()
+        if results:
+            for events, compact in results:
+                for arr in self._readback_arrays(events, compact):
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:  # noqa: BLE001 — backends without
+                        break  # async copy: device_get pays the round-trip
+            self._inflight = results
+            self._inflight_seq = self._flush_seq
         if self._host_prepared is None:
             # cold start (or post-slide/reset): callers need SOME snapshot
             if self._inflight is None:
                 self._inflight = self._dispatch_empty()
+                self._inflight_seq = self._flush_seq
             self._sync_inflight()
 
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
+        self._flush_seq += 1
         if self.pipelined:
             with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
                 self._flush_pipelined()
@@ -784,19 +1144,22 @@ class VotePlaneGroup:
                 and self._host_prepared is not None):
             return
         with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
-            events = self._dispatch_pending()
-            if events is None:  # cold start: no votes recorded anywhere yet
-                events = self._dispatch_empty()
+            results = self._dispatch_pending()
+            if not results:  # cold start: no votes recorded anywhere yet
+                results = self._dispatch_empty()
             # ONE bundled device->host transfer (separate np.asarray calls
             # cost one link round-trip each — painful on a remote device)
-            self._absorb(events)
+            self._absorb_results(results, overlapped=False)
 
     def _sync_inflight(self) -> None:
-        """Absorb any in-flight step NOW (window/view operations must not
+        """Absorb any in-flight steps NOW (window/view operations must not
         run with stale events pending under the OLD slot mapping)."""
         if self._inflight is not None:
-            events, self._inflight = self._inflight, None
-            self._absorb(events)
+            results, self._inflight = self._inflight, None
+            # overlapped iff a LATER flush call absorbs it: the dispatch's
+            # round-trip hid behind at least one full tick of host work
+            self._absorb_results(
+                results, overlapped=self._flush_seq > self._inflight_seq)
 
     def slide_member(self, member_idx: int, delta: int) -> None:
         self.flush()
@@ -810,6 +1173,24 @@ class VotePlaneGroup:
             self._states = _group_slide(self._states, jnp.asarray(deltas))
         self.version += 1
         self._host_prepared = None
+        # device-eval mirrors roll with the member's window (the device
+        # applied the identical roll/clamp in _slide_core — prepared_acked
+        # rolled too, so surviving certs are NOT re-reported and the
+        # mirror must keep them)
+        mi, s = member_idx, self._log_size
+        for mir in (self._mir_prepared[mi], self._mir_commit_ok[mi]):
+            if delta < s:
+                mir[:s - delta] = mir[delta:]
+                mir[s - delta:] = False
+            else:
+                mir[:] = False
+        self._mir_stable[mi] = False
+        self._mir_frontier[mi] = max(int(self._mir_frontier[mi]) - delta, 0)
+        member = self._members[mi]
+        member._delta_prepared = [
+            x - delta for x in member._delta_prepared if x >= delta]
+        member._delta_committed = [
+            x - delta for x in member._delta_committed if x >= delta]
 
     def reset_member(self, member_idx: int) -> None:
         # pending for this member was cleared by the caller; other members'
@@ -827,6 +1208,15 @@ class VotePlaneGroup:
                 self._states, jnp.int32(member_idx))
         self.version += 1
         self._host_prepared = None
+        # the member's device plane is all-zero now; its mirrors must be
+        # too, or stale certs from the old view would answer queries
+        self._mir_prepared[member_idx] = False
+        self._mir_commit_ok[member_idx] = False
+        self._mir_stable[member_idx] = False
+        self._mir_frontier[member_idx] = 0
+        member = self._members[member_idx]
+        member._delta_prepared = []
+        member._delta_committed = []
 
 
 class _MemberPlane(DeviceVotePlane):
@@ -850,7 +1240,12 @@ class _MemberPlane(DeviceVotePlane):
         self._host_prepared = None
         self._host_prepare_counts = None
         self._host_commit_counts = None
+        self._host_commit_ok = None
         self._host_stable = None
+        # device-eval delta accumulators, filled by the group's
+        # _apply_compact as each dispatch's compact events absorb
+        self._delta_prepared: List[int] = []
+        self._delta_committed: List[int] = []
         self.defer_flush_on_query = False
 
     @property
@@ -881,6 +1276,22 @@ class _MemberPlane(DeviceVotePlane):
         pass
 
     @property
+    def readback_bytes_total(self) -> int:
+        return self._group.readback_bytes_total
+
+    @readback_bytes_total.setter
+    def readback_bytes_total(self, value) -> None:
+        pass
+
+    @property
+    def readbacks(self) -> int:
+        return self._group.readbacks
+
+    @readbacks.setter
+    def readbacks(self, value) -> None:
+        pass
+
+    @property
     def has_buffered_votes(self) -> bool:
         # pipelined group: votes dispatched but not yet in the snapshot
         # must keep the services' lost-wakeup guard armed, exactly like
@@ -891,11 +1302,16 @@ class _MemberPlane(DeviceVotePlane):
         self._group.flush()
 
     def _copy_slices(self) -> None:
-        self._host_prepared = self._group._host_prepared[self._mi]
-        self._host_prepare_counts = self._group._host_prepare_counts[self._mi]
-        self._host_commit_counts = self._group._host_commit_counts[self._mi]
-        self._host_stable = self._group._host_stable[self._mi]
-        self._seen_version = self._group.version
+        g = self._group
+        self._host_prepared = g._host_prepared[self._mi]
+        self._host_commit_ok = g._host_commit_ok[self._mi]
+        self._host_stable = g._host_stable[self._mi]
+        # counts stay device-resident on the fast path (None => the
+        # prepare_count diagnostic fetches its scalar on demand)
+        pc, cc = g._host_prepare_counts, g._host_commit_counts
+        self._host_prepare_counts = None if pc is None else pc[self._mi]
+        self._host_commit_counts = None if cc is None else cc[self._mi]
+        self._seen_version = g.version
         self._events = True
 
     def _refresh(self) -> None:
@@ -929,3 +1345,37 @@ class _MemberPlane(DeviceVotePlane):
         self._pending.clear()
         self._group.reset_member(self._mi)
         self._events = None
+
+    # --- ordering fast path: the group feeds per-member deltas --------
+
+    @property
+    def host_eval(self) -> bool:
+        return self._group.host_eval
+
+    @host_eval.setter
+    def host_eval(self, value) -> None:  # eval mode is a GROUP property
+        raise AttributeError("set host_eval on the VotePlaneGroup")
+
+    def poll_deltas(self) -> Optional[PlaneDeltas]:
+        g = self._group
+        if g.host_eval:
+            return None
+        if not self._delta_prepared and not self._delta_committed:
+            return None  # quiet poll: allocation-free (most members/ticks)
+        prepared, self._delta_prepared = self._delta_prepared, []
+        committed, self._delta_committed = self._delta_committed, []
+        return PlaneDeltas(sorted(prepared), sorted(committed),
+                           int(g._mir_frontier[self._mi]))
+
+    def prepare_count(self, pp_seq_no: int) -> int:
+        slot = self._slot(pp_seq_no)
+        if slot is None:
+            return 0
+        self.events()
+        if self._host_prepare_counts is not None:
+            return int(self._host_prepare_counts[slot])
+        ev = self._group._dev_events
+        if ev is None:
+            return 0
+        # one scalar fetched on demand from the device-resident events
+        return int(jax.device_get(ev.prepare_counts[self._mi, slot]))

@@ -108,7 +108,8 @@ def _submit_bursty(pool, target: int) -> None:
 
 def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
             tick_interval: float, seed: int = 11, adaptive: bool = False,
-            bursty: bool = False, mesh=None, trace: bool = False) -> dict:
+            bursty: bool = False, mesh=None, trace: bool = False,
+            host_eval: bool = False) -> dict:
     """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
     measurement: the gate counts every dispatch from pool construction on
     (cold-start/compile steps included), because the budget protects the
@@ -122,7 +123,8 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     })
     pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
                    device_quorum=True, shadow_check=False,
-                   num_instances=instances, mesh=mesh, trace=trace)
+                   num_instances=instances, mesh=mesh, trace=trace,
+                   host_eval=host_eval)
 
     def min_ordered():
         return min(len(nd.ordered_digests) for nd in pool.nodes)
@@ -169,6 +171,13 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         # identifies the whole pool's ordering (the sharded gate compares
         # it against the 1-device run)
         "ordered_hash": pool.ordered_hash(),
+        # ordering fast path: what actually crossed the device->host
+        # boundary (compact deltas by default, the full event matrix
+        # under host_eval) — the readback gate compares the two
+        "eval_mode": pool.vote_group.eval_mode,
+        "readback_bytes": pool.vote_group.readback_bytes_total,
+        "readbacks": pool.vote_group.readbacks,
+        "readbacks_overlapped": pool.vote_group.readbacks_overlapped,
     }
     if mesh is not None:
         result["shards"] = pool.vote_group.shards
@@ -377,6 +386,59 @@ def _measure_saturation(args, rate: float, seed: int) -> dict:
     }
 
 
+def readback_gate(args, base: "dict | None" = None) -> "tuple[dict, list]":
+    """Ordering fast path gate: device-side quorum eval (compact delta
+    readback, the default) vs the ``host_eval`` full-event-matrix
+    fallback on the SAME n=16/k=6 workload and seed. The eval mode may
+    change WHAT crosses the device->host link, never the ordering:
+    digests must be bit-identical, the compact run's bytes/readback must
+    sit under ``--readback-budget`` AND well below the matrix run's, and
+    ordered/sim-second must not regress beyond ``--readback-tolerance``.
+    ``base`` reuses the sharded gate's single-device run (identical
+    arguments, device eval) instead of re-paying the cold simulation."""
+    if base is None:
+        base = measure(args.sharded_nodes, args.sharded_instances,
+                       args.batches, args.batch_size, args.tick,
+                       seed=args.seed)
+    host = measure(args.sharded_nodes, args.sharded_instances,
+                   args.batches, args.batch_size, args.tick,
+                   seed=args.seed, host_eval=True)
+    failures = []
+    if base["ordered_hash"] != host["ordered_hash"]:
+        failures.append("device-eval ordered digests diverge from the "
+                        "host_eval fallback (fast path changed semantics)")
+    d_per = (base["readback_bytes"] / base["readbacks"]
+             if base["readbacks"] else 0.0)
+    h_per = (host["readback_bytes"] / host["readbacks"]
+             if host["readbacks"] else 0.0)
+    if d_per > args.readback_budget:
+        failures.append(f"device-eval readback {d_per:.0f} bytes/readback "
+                        f"over budget {args.readback_budget}")
+    # the structural claim: compact deltas, not the event matrix — the
+    # fast path must read back a small fraction of the fallback's bytes
+    if h_per and d_per > h_per * 0.5:
+        failures.append(f"device-eval readback {d_per:.0f} bytes is not "
+                        f"compact vs the event matrix {h_per:.0f}")
+    tol = args.readback_tolerance
+    d_tps = base["ordered_per_sim_second"] or 0.0
+    h_tps = host["ordered_per_sim_second"] or 0.0
+    if d_tps < h_tps * (1.0 - tol):
+        failures.append(f"device-eval ordered/sim-sec {d_tps} regresses "
+                        f"host_eval {h_tps} beyond {tol:.0%}")
+    record = {
+        "device_eval": base,
+        "host_eval": host,
+        "readback_budget": args.readback_budget,
+        "readback_tolerance": tol,
+        "digests_match": base["ordered_hash"] == host["ordered_hash"],
+        "device_bytes_per_readback": round(d_per, 1),
+        "host_bytes_per_readback": round(h_per, 1),
+        "readback_compression": round(h_per / d_per, 1) if d_per else None,
+        "sim_throughput_ratio": round(d_tps / h_tps, 4) if h_tps else None,
+    }
+    return record, failures
+
+
 def ingress_gate(args) -> "tuple[dict, list]":
     """Saturation gate (ingress plane): at n=16/k=6, open-loop overload
     must shed DETERMINISTICALLY behind a bounded queue — never grow it
@@ -453,6 +515,15 @@ def main() -> int:
                     help="skip the flight-recorder overhead comparison")
     ap.add_argument("--no-ingress-gate", action="store_true",
                     help="skip the open-loop saturation/admission gate")
+    ap.add_argument("--no-readback-gate", action="store_true",
+                    help="skip the device-eval vs host-eval ordering "
+                         "fast path comparison")
+    ap.add_argument("--readback-budget", type=float, default=32768,
+                    help="max device->host bytes per readback the "
+                         "compact (device-eval) run may average")
+    ap.add_argument("--readback-tolerance", type=float, default=0.05,
+                    help="max fractional ordered/sim-second regression "
+                         "device eval may show vs the host_eval fallback")
     ap.add_argument("--ingress-capacity", type=int, default=16,
                     help="bounded auth-queue capacity for the ingress "
                          "gate (small on purpose: overload must engage "
@@ -519,6 +590,10 @@ def main() -> int:
     if not args.no_trace_gate:
         record, failures = tracing_gate(args, base=sharded_single)
         result["tracing_gate"] = record
+        over.extend(failures)
+    if not args.no_readback_gate:
+        record, failures = readback_gate(args, base=sharded_single)
+        result["readback_gate"] = record
         over.extend(failures)
     if not args.no_ingress_gate:
         record, failures = ingress_gate(args)

@@ -37,6 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from indy_plenum_tpu.observability.trace import (  # noqa: E402
     critical_path,
     load_jsonl,
+    overlap_report,
     phase_percentiles,
     to_chrome_trace,
 )
@@ -61,6 +62,9 @@ def main() -> int:
                     help="per-phase latency percentiles only")
     ap.add_argument("--critical-path", action="store_true",
                     help="per-batch dominant-phase breakdown only")
+    ap.add_argument("--overlap", action="store_true",
+                    help="per-tick host/device overlap fraction + "
+                         "readback-bytes column (ordering fast path)")
     ap.add_argument("--chrome", metavar="OUT",
                     help="write Chrome trace-event JSON (Perfetto)")
     ap.add_argument("--node", default=None,
@@ -75,12 +79,15 @@ def main() -> int:
         return 2
 
     record = {"dump": args.dump, "summary": _counts(events)}
-    # --phases/--critical-path narrow the view; --chrome is orthogonal
-    view_selected = args.phases or args.critical_path
+    # --phases/--critical-path/--overlap narrow the view; --chrome is
+    # orthogonal
+    view_selected = args.phases or args.critical_path or args.overlap
     if args.phases or not view_selected:
         record["phase_latency"] = phase_percentiles(events, node=args.node)
     if args.critical_path or not view_selected:
         record["critical_path"] = critical_path(events, node=args.node)
+    if args.overlap or not view_selected:
+        record["overlap"] = overlap_report(events, node=args.node)
     if not view_selected:
         record["flight_events"] = _flight_events(events)
     if args.chrome:
@@ -110,6 +117,21 @@ def main() -> int:
             share = cp["phase_share"].get(phase, 0.0)
             print(f"  {phase:10s} dominated {cnt} batches "
                   f"(share of attributed time: {share:.1%})")
+    if "overlap" in record:
+        ov = record["overlap"]
+        bpt = ov["readback_bytes_per_tick"]
+        print(f"dispatch overlap over {ov['ticks']} ticks: "
+              f"{ov['overlap_fraction']:.1%} of {ov['readbacks']} "
+              f"readbacks overlapped a full tick of host work; "
+              f"readback bytes/tick p50={bpt['p50']} max={bpt['max']} "
+              f"(total {ov['readback_bytes_total']})")
+        if args.overlap:
+            print(f"  {'tick_ts':>14s} {'dispatches':>10s} {'votes':>7s} "
+                  f"{'readbacks':>9s} {'overlapped':>10s} {'rb_bytes':>9s}")
+            for t in ov["per_tick"]:
+                print(f"  {t.get('ts', 0):>14.6f} {t['dispatches']:>10d} "
+                      f"{t['votes']:>7d} {t['readbacks']:>9d} "
+                      f"{t['overlapped']:>10d} {t['readback_bytes']:>9d}")
     if record.get("flight_events"):
         print("flight events:")
         for ev in record["flight_events"]:

@@ -63,6 +63,43 @@ def test_governor_holds_inside_the_band():
     assert set(g.trajectory) == {0.05}
 
 
+def test_governor_absorb_clamp_caps_effective_interval_only():
+    """Ordering fast path: while a pipelined step's verdicts are in
+    flight, the RETURNED interval is capped at the configured base so
+    the absorb tick comes promptly — but the law's own interval state
+    (and hence the occupancy trajectory it will follow once the wave
+    completes) is untouched, and inflight=False calls stay bit-identical
+    to the clamp-free law."""
+    g = make_governor()
+    for _ in range(20):  # idle: widen to the ceiling
+        g.observe(votes=0, capacity=0, dispatches=0)
+    assert g.interval == g.max_interval
+    # a wave dispatches with verdicts in flight: effective cadence drops
+    # to the base interval, law state holds at what occupancy says
+    eff = g.observe(votes=32, capacity=512, dispatches=1, inflight=True)
+    assert eff == g.absorb_interval == 0.05
+    assert g.interval == g.max_interval  # law state undisturbed
+    assert g.absorb_clamps == 1
+    assert g.trajectory[-1] == eff  # trajectory records the real cadence
+    # wave complete: the law cadence resumes instantly
+    assert g.observe(votes=0, capacity=0, dispatches=0) == g.max_interval
+    # law already at/below base: inflight must not touch the interval
+    tight = make_governor()
+    for _ in range(10):
+        tight.observe(votes=1536, capacity=1536, dispatches=3)
+    assert tight.interval == tight.min_interval
+    assert tight.observe(votes=512, capacity=512, dispatches=1,
+                         inflight=True) == tight.min_interval
+    assert tight.absorb_clamps == 0
+    # inflight=False twin: bit-identical to the pre-clamp law
+    a, b = make_governor(), make_governor()
+    seq = [(0, 0, 0)] * 6 + [(128, 512, 1)] * 4 + [(0, 0, 0)] * 3
+    for votes, cap, disp in seq:
+        a.observe(votes, cap, disp)
+        b.observe(votes, cap, disp, inflight=False)
+    assert a.trajectory == b.trajectory and b.absorb_clamps == 0
+
+
 def test_governor_determinism_same_observation_sequence():
     seq = ([(0, 0, 0)] * 5 + [(512, 512, 2)] * 7 + [(3, 64, 1)] * 9
            + [(0, 0, 0)] * 4)

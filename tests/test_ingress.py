@@ -330,16 +330,21 @@ def test_read_service_device_kernel_batch():
 
 
 def test_read_service_ledger_backing_serves_committed_txns():
-    pool = SimPool(n_nodes=4, seed=13, real_execution=True)
+    # one request per 3PC batch: checkpoints live in pp_seq_no space, so
+    # 10 submissions deterministically cross the CHK_FREQ=5 boundary
+    config = getConfig({"CHK_FREQ": 5, "LOG_SIZE": 15,
+                        "Max3PCBatchSize": 1, "Max3PCBatchWait": 0.05})
+    pool = SimPool(n_nodes=4, seed=13, real_execution=True, config=config)
     for i in range(4):
         pool.submit_request(i)
     pool.run_for(15)
     assert pool.honest_nodes_agree()
     from indy_plenum_tpu.common.constants import DOMAIN_LEDGER_ID
 
-    ledger = pool.nodes[0].boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    node = pool.nodes[0]
+    ledger = node.boot.db.get_ledger(DOMAIN_LEDGER_ID)
     assert ledger.size >= 4
-    backing = LedgerBacking(ledger)
+    backing = LedgerBacking(ledger, bus=node.internal_bus)
     rs = ReadService(backing, mode="host",
                      clock=pool.timer.get_current_time)
     for i in range(ledger.size):
@@ -350,13 +355,16 @@ def test_read_service_ledger_backing_serves_committed_txns():
     # proofs are over the ledger's own leaf bytes
     assert out[1].leaf == ledger.serializer.dumps(
         ledger.get_by_seq_no(2))
-    # new commits surface after refresh (and only after)
+    # new commits surface WITHOUT any manual refresh: the snapshot rides
+    # the node's checkpoint-stabilized hook (commit through a CHK_FREQ
+    # boundary so a checkpoint stabilizes during the run)
     size_before = backing.tree_size
-    for i in range(4, 6):
+    refreshes_before = backing.refreshes
+    for i in range(4, 10):
         pool.submit_request(i)
     pool.run_for(10)
     assert ledger.size > size_before
-    backing.refresh()
+    assert backing.refreshes > refreshes_before
     assert backing.tree_size == ledger.size
     assert rs.read_one(backing.tree_size - 1).verified
 

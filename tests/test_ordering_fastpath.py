@@ -1,0 +1,257 @@
+"""On-device ordering fast path (ISSUE 7): compact-vs-full eval.
+
+Contract under test (README "Performance" / the readback gate in
+``scripts/check_dispatch_budget.py``): with device-side quorum eval (the
+default) a tick reads back only O(newly certified + frontier) bytes —
+the ``host_eval`` fallback fetches the full (member x window) event
+matrix — and the eval mode may change WHAT crosses the device->host
+link, never the ordering. Seeded runs must produce bit-identical
+``ordered_hash`` (and protocol-timeline ``trace_hash``) either way,
+through view changes, on the 4-way mesh, and under chaos.
+"""
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+from indy_plenum_tpu.tpu.vote_plane import DeviceVotePlane  # noqa: E402
+
+VALIDATORS = ["n0", "n1", "n2", "n3"]
+
+
+def _certify(plane, pp_seq_no, prepares=3, commits=3):
+    """Record a full 3PC vote wave for one slot (n=4, f=1: prepare cert
+    needs n-f-1=2 matching PREPAREs, commit cert n-f=3 COMMITs)."""
+    plane.record_preprepare(pp_seq_no)
+    for sender in VALIDATORS[1:1 + prepares]:
+        plane.record_prepare(sender, pp_seq_no)
+    for sender in VALIDATORS[:commits]:
+        plane.record_commit(sender, pp_seq_no)
+
+
+# ---------------------------------------------------------------------
+# tier-1: standalone-plane semantics
+# ---------------------------------------------------------------------
+
+def test_standalone_plane_compact_matches_host_eval():
+    """Same vote sequence through both eval modes: identical quorum
+    verdicts, and the device-eval plane feeds the deltas + frontier that
+    the host_eval fallback would have recomputed by rescanning."""
+    # a realistic window: the compact readback is FIXED-size (delta cap
+    # slots + frontier), the matrix fallback scales with log_size
+    dev = DeviceVotePlane(VALIDATORS, log_size=256, n_checkpoints=2)
+    host = DeviceVotePlane(VALIDATORS, log_size=256, n_checkpoints=2,
+                           host_eval=True)
+    assert dev.delta_feed and not host.delta_feed
+    for plane in (dev, host):
+        _certify(plane, 1)
+        _certify(plane, 2)
+        plane.record_preprepare(4)  # no certs: stays out of every delta
+        plane.sync()
+    for pp in (1, 2):
+        assert dev.has_prepare_quorum(pp) and host.has_prepare_quorum(pp)
+        assert dev.has_commit_quorum(pp) and host.has_commit_quorum(pp)
+        assert dev.prepare_count(pp) == host.prepare_count(pp) == 3
+    assert not dev.has_commit_quorum(4) and not host.has_commit_quorum(4)
+    # the fast path names exactly the slots that crossed their
+    # thresholds (h-relative: pp_seq_no = h + slot + 1)
+    deltas = dev.poll_deltas()
+    assert deltas is not None
+    assert deltas.prepared == [0, 1]
+    assert deltas.committed == [0, 1]
+    assert deltas.frontier == 2  # both certs are contiguous from h
+    # consumed once; quiet polls are None (allocation-free)
+    assert dev.poll_deltas() is None
+    # the fallback never feeds deltas — services rescan snapshots
+    assert host.poll_deltas() is None
+    # the structural claim: the compact readback is a small fraction of
+    # the event matrix the fallback fetches per refresh
+    assert dev.readbacks == host.readbacks
+    assert dev.readback_bytes_total < host.readback_bytes_total / 4
+
+
+def test_frontier_advances_in_order_only():
+    """The frontier is the leading CONTIGUOUS run of commit-certified
+    slots: a gap pins it, filling the gap releases the whole run."""
+    plane = DeviceVotePlane(VALIDATORS, log_size=16, n_checkpoints=2)
+    _certify(plane, 2)
+    _certify(plane, 3)
+    plane.sync()
+    deltas = plane.poll_deltas()
+    assert deltas.committed == [1, 2]
+    assert deltas.frontier == 0  # slot 0 (pp_seq 1) still missing
+    _certify(plane, 1)
+    plane.sync()
+    deltas = plane.poll_deltas()
+    assert deltas.committed == [0]
+    assert deltas.frontier == 3  # the gap filled: the whole run releases
+
+
+def test_delta_overflow_falls_back_to_full_events():
+    """A step whose newly-certified count exceeds the fixed delta
+    capacity reconciles from the full device-resident events — same
+    verdicts, bigger (but still deterministic) readback."""
+    over = DeviceVotePlane(VALIDATORS, log_size=32, n_checkpoints=2,
+                           delta_cap=2)
+    wide = DeviceVotePlane(VALIDATORS, log_size=32, n_checkpoints=2)
+    # same delta cap, no overflow: the per-readback byte baseline
+    calm = DeviceVotePlane(VALIDATORS, log_size=32, n_checkpoints=2,
+                           delta_cap=2)
+    _certify(calm, 1)
+    calm.sync()
+    for plane in (over, wide):
+        for pp in range(1, 9):  # 8 slots certify inside ONE flush
+            _certify(plane, pp)
+        plane.sync()
+    d_over, d_wide = over.poll_deltas(), wide.poll_deltas()
+    assert d_over.prepared == d_wide.prepared == list(range(8))
+    assert d_over.committed == d_wide.committed == list(range(8))
+    assert d_over.frontier == d_wide.frontier == 8
+    for pp in range(1, 9):
+        assert over.has_commit_quorum(pp)
+    # the overflow path actually paid for the full-events fetch: same
+    # readback count and compact struct size as the calm run, more bytes
+    assert over.readbacks == calm.readbacks
+    assert over.readback_bytes_total > calm.readback_bytes_total
+
+
+def test_slide_rebases_unpolled_deltas():
+    """Checkpoint slide between certify and poll: unpolled delta slots
+    re-base to the new h; slots below it drop (their consumers are done
+    — the checkpoint stabilized past them)."""
+    plane = DeviceVotePlane(VALIDATORS, log_size=16, n_checkpoints=4)
+    _certify(plane, 1)
+    _certify(plane, 6)
+    plane.sync()
+    plane.slide_to(4)
+    deltas = plane.poll_deltas()
+    assert deltas.committed == [1]  # pp_seq 6 is slot 1 under h=4
+    assert deltas.prepared == [1]
+    assert plane.has_commit_quorum(6)
+    plane.reset()
+    assert plane.poll_deltas() is None  # view change voids everything
+
+
+# ---------------------------------------------------------------------
+# tier-1: pool-level digest identity + the readback contract
+# ---------------------------------------------------------------------
+
+def _run_pool(host_eval, seed=41, view_change=False, mesh=None,
+              n_nodes=4, k=1, trace=True):
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 2,
+                     "QuorumTickInterval": 0.05,
+                     "QuorumTickAdaptive": True})
+    pool = SimPool(n_nodes, seed=seed, config=cfg, device_quorum=True,
+                   shadow_check=False, num_instances=k, mesh=mesh,
+                   host_eval=host_eval, trace=trace)
+    primary = pool.nodes[0].data.primaries[0]
+    for i in range(8):
+        pool.submit_request(i)
+    pool.run_for(10)
+    if view_change:
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 104):
+            pool.submit_request(i)
+        pool.run_for(12)
+    assert pool.honest_nodes_agree()
+    return pool
+
+
+def test_pool_digest_identity_device_vs_host_eval():
+    """Same seed, both eval modes: bit-identical ordered_hash AND
+    protocol-timeline trace_hash (the dispatch category legitimately
+    differs — flush.readback carries the byte counts being changed)."""
+    dev = _run_pool(host_eval=False)
+    host = _run_pool(host_eval=True)
+    assert dev.vote_group.eval_mode == "device"
+    assert host.vote_group.eval_mode == "host"
+    assert dev.ordered_hash() == host.ordered_hash()
+    assert dev.trace.trace_hash(exclude_cats=("dispatch",)) \
+        == host.trace.trace_hash(exclude_cats=("dispatch",))
+    # the acceptance contract: per-tick transfer is O(newly ordered +
+    # frontier), not O(member x instance x window) — asserted via the
+    # flush.readback trace attribute, not just the counters
+    def readback_bytes(pool):
+        return [ev["args"]["bytes"] for ev in pool.trace.events()
+                if ev["name"] == "flush.readback" and ev.get("args")]
+
+    dev_rb, host_rb = readback_bytes(dev), readback_bytes(host)
+    assert sum(dev_rb) == dev.vote_group.readback_bytes_total
+    assert sum(host_rb) == host.vote_group.readback_bytes_total
+    # the full event matrix costs O(M * S) per fetch; every compact
+    # readback must undercut a single matrix fetch by a wide margin
+    matrix_bytes = min(b for b in host_rb if b)
+    assert max(dev_rb) < matrix_bytes / 4
+    assert sum(dev_rb) < sum(host_rb) / 4
+    # the pipelined default actually overlapped: most absorbs consumed a
+    # step dispatched by an earlier flush call
+    assert dev.vote_group.readbacks_overlapped \
+        >= dev.vote_group.readbacks // 2
+
+
+@pytest.mark.perf
+def test_pool_digest_identity_incl_view_change():
+    """The eval mode survives a view change bit-for-bit (reset/slide
+    paths clear the device-eval mirrors exactly like the device state)."""
+    dev = _run_pool(host_eval=False, seed=37, view_change=True)
+    host = _run_pool(host_eval=True, seed=37, view_change=True)
+    assert dev.ordered_hash() == host.ordered_hash()
+    assert dev.trace.trace_hash(exclude_cats=("dispatch",)) \
+        == host.trace.trace_hash(exclude_cats=("dispatch",))
+
+
+# ---------------------------------------------------------------------
+# slow lane: the mesh path + chaos
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.perf
+def test_mesh_digest_identity_device_vs_host_eval(eight_devices):
+    """Compact readback through the 4-way shard_map'd group step: the
+    sharded fast path orders identically to the sharded host_eval
+    fallback AND to the 1-device fast path, through a view change."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(eight_devices[:4]), ("members",))
+    dev = _run_pool(host_eval=False, seed=37, view_change=True,
+                    mesh=mesh, n_nodes=8, k=2)
+    host = _run_pool(host_eval=True, seed=37, view_change=True,
+                     mesh=mesh, n_nodes=8, k=2)
+    single = _run_pool(host_eval=False, seed=37, view_change=True,
+                       mesh=None, n_nodes=8, k=2)
+    assert dev.vote_group.shards == 4
+    assert dev.ordered_hash() == host.ordered_hash() \
+        == single.ordered_hash()
+    assert dev.vote_group.readback_bytes_total \
+        < host.vote_group.readback_bytes_total / 4
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_f_crash_partition_device_vs_host_eval():
+    """f crash + partition through the fast path: all invariants hold
+    and every node's ordered-digest hash equals the host_eval fallback
+    run on the same seed (the chaos replay contract extends to the eval
+    mode)."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    dev = run_scenario("f_crash_partition", seed=7, device_quorum=True,
+                       quorum_tick_interval=0.05,
+                       quorum_tick_adaptive=True)
+    assert dev.verdict_as_expected, dev.failed
+    assert not dev.expected_failures
+    host = run_scenario("f_crash_partition", seed=7, device_quorum=True,
+                        quorum_tick_interval=0.05,
+                        quorum_tick_adaptive=True, host_eval=True)
+    assert host.verdict_as_expected, host.failed
+    assert dev.ordered_hash_per_node == host.ordered_hash_per_node
+    assert dev.dispatch_mode["host_eval"] is False
+    assert host.dispatch_mode["host_eval"] is True

@@ -37,10 +37,12 @@ class ChaosReport:
     first_violation: Optional[Tuple[float, str]] = None
     virtual_seconds: float = 0.0
     # how the run was routed through the dispatch plane (device quorum /
-    # tick / adaptive / mesh width): replay_command must reproduce the
-    # exact pipeline, not just the fault schedule — a mesh run replayed
-    # unsharded would still order identically (that's the tested
-    # contract) but would no longer exercise the path being debugged
+    # tick / adaptive / mesh shape — "4" member-sharded or "2x2" for the
+    # 2-axis member x validator fabric): replay_command must reproduce
+    # the exact pipeline, not just the fault schedule — a mesh run
+    # replayed unsharded (or a 2-axis run replayed 1-axis) would still
+    # order identically (that's the tested contract) but would no longer
+    # exercise the path being debugged
     dispatch_mode: Dict[str, Any] = field(default_factory=dict)
     # consensus flight recorder (observability.trace): the trace
     # fingerprint (bit-identical across replays of the same seed), where

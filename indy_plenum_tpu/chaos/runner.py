@@ -128,7 +128,11 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             "device_quorum": device_quorum,
             "tick": quorum_tick_interval,
             "adaptive": quorum_tick_adaptive,
-            "mesh": int(mesh.devices.size) if mesh is not None else 0,
+            # the mesh SHAPE, chaos_run.py --mesh syntax ("4" = member
+            # sharded, "2x2" = the 2-axis fabric): replay_command must
+            # reproduce the exact grid, not just the device count
+            "mesh": ("x".join(str(d) for d in mesh.devices.shape)
+                     if mesh is not None else 0),
             "host_eval": host_eval,
             "trace": trace,
         },

@@ -397,10 +397,26 @@ def overlap_report(events: List[Dict[str, Any]],
     hid behind at least one full tick of host work (the pipelined
     contract). ``readback_bytes`` is what actually crossed the
     device->host boundary — O(newly certified + frontier) in device
-    eval, the full event matrix under host_eval."""
+    eval, the full event matrix under host_eval.
+
+    Mesh runs (the scale-out quorum fabric) additionally carry per-shard
+    columns: ``flush.readback`` events are per member shard (``shard``
+    arg) and ``flush.dispatch`` splits its votes per occupancy-grid cell
+    (``shard_votes``), so the ``per_shard`` block — readback bytes per
+    member shard, votes/share per cell — makes a hot shard visible from
+    a trace dump alone."""
     ticks: List[Dict[str, Any]] = []
     cur = {"dispatches": 0, "votes": 0, "readbacks": 0, "overlapped": 0,
            "readback_bytes": 0}
+    shard_bytes: Dict[int, int] = {}
+    shard_readbacks: Dict[int, int] = {}
+    cell_votes: List[int] = []
+    # per-shard data stages per tick and commits at tick.flush, so the
+    # per_shard block covers exactly the same closed-tick window as the
+    # totals (a trailing partial tick is dropped from BOTH views)
+    pend_shard_bytes: Dict[int, int] = {}
+    pend_shard_readbacks: Dict[int, int] = {}
+    pend_cell_votes: List[int] = []
     for ev in events:
         if ev.get("cat") != "dispatch":
             continue
@@ -410,20 +426,45 @@ def overlap_report(events: List[Dict[str, Any]],
         if name == "flush.dispatch":
             cur["dispatches"] += 1
             cur["votes"] += args.get("votes", 0)
+            sv = args.get("shard_votes")
+            if sv:
+                if len(pend_cell_votes) < len(sv):
+                    pend_cell_votes.extend(
+                        [0] * (len(sv) - len(pend_cell_votes)))
+                for ci, v in enumerate(sv):
+                    pend_cell_votes[ci] += v
         elif name == "flush.readback":
             cur["readbacks"] += 1
             cur["readback_bytes"] += args.get("bytes", 0)
             if args.get("overlapped"):
                 cur["overlapped"] += 1
+            shard = args.get("shard")
+            if shard is not None:
+                pend_shard_bytes[shard] = (pend_shard_bytes.get(shard, 0)
+                                           + args.get("bytes", 0))
+                pend_shard_readbacks[shard] = \
+                    pend_shard_readbacks.get(shard, 0) + 1
         elif name == "tick.flush":
             cur["ts"] = ev["ts"]
             ticks.append(cur)
             cur = {"dispatches": 0, "votes": 0, "readbacks": 0,
                    "overlapped": 0, "readback_bytes": 0}
+            for s, b in pend_shard_bytes.items():
+                shard_bytes[s] = shard_bytes.get(s, 0) + b
+            for s, n in pend_shard_readbacks.items():
+                shard_readbacks[s] = shard_readbacks.get(s, 0) + n
+            if len(cell_votes) < len(pend_cell_votes):
+                cell_votes.extend(
+                    [0] * (len(pend_cell_votes) - len(cell_votes)))
+            for ci, v in enumerate(pend_cell_votes):
+                cell_votes[ci] += v
+            pend_shard_bytes = {}
+            pend_shard_readbacks = {}
+            pend_cell_votes = []
     byte_series = sorted(t["readback_bytes"] for t in ticks)
     readbacks = sum(t["readbacks"] for t in ticks)
     overlapped = sum(t["overlapped"] for t in ticks)
-    return {
+    out = {
         "ticks": len(ticks),
         "readbacks": readbacks,
         # host/device overlap fraction: readbacks whose round-trip hid
@@ -437,6 +478,24 @@ def overlap_report(events: List[Dict[str, Any]],
         },
         "per_tick": ticks,
     }
+    if shard_bytes or cell_votes:
+        n_shards = max([s + 1 for s in shard_bytes] or [0])
+        total_votes = sum(cell_votes)
+        out["per_shard"] = {
+            # member shards: what each shard's compact blocks cost to
+            # read back (and how many blocks absorbed)
+            "readback_bytes": [shard_bytes.get(s, 0)
+                               for s in range(n_shards)],
+            "readbacks": [shard_readbacks.get(s, 0)
+                          for s in range(n_shards)],
+            # occupancy-grid cells (member block x validator block,
+            # flattened): each cell's vote count and share — the
+            # dump-local analog of VotePlaneGroup.shard_occupancy
+            "votes": list(cell_votes),
+            "vote_share": [round(v / total_votes, 4) if total_votes
+                           else 0.0 for v in cell_votes],
+        }
+    return out
 
 
 # ----------------------------------------------------------------------

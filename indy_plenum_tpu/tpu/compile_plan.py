@@ -1,0 +1,168 @@
+"""Compilation plans for the grouped vote-plane step functions.
+
+One helper owns the decision of HOW each step function compiles for a
+given mesh shape (the Titanax pattern, SNIPPETS.md [3]): callers state
+WHAT runs (the step/slide/zero bodies over the member-stacked
+:class:`~indy_plenum_tpu.tpu.quorum.VoteState`) and receive a resolved
+:class:`CompilePlan`; the strategy per function is picked here, in one
+place, instead of hand-building a ``shard_map`` triple per case:
+
+- **step** — ``jit`` on one device; ``shard_map`` on any mesh. The step
+  is the hot dispatch and must be provably communication-free along the
+  member axis (PR 4's contract: explicit SPMD, never a silent
+  all-gather), and under the 2-axis member x validator fabric its body
+  NEEDS manual collectives (``lax.axis_index`` for the scatter row
+  offset, ``psum`` for the quorum counts) — both are exactly what
+  ``shard_map`` expresses and ``pjit`` cannot guarantee.
+- **slide / zero** — ``jit`` on one device; ``pjit`` with explicit
+  NamedShardings on any mesh. Both bodies are pure per-member maps
+  (roll/mask along unsharded trailing axes) whose layout the in/out
+  shardings fully describe, so the partitioner cannot introduce
+  communication — the "pjit when explicit shardings are provided"
+  branch of the pattern, and one compilation instead of a hand-written
+  shard_map wrapper per rare-path function.
+
+The plan is cached per (mesh, n_validators, padded rows, delta cap) —
+the same key space ``_sharded_group_fns`` used before this layer
+replaced it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import quorum as q
+
+
+# double-buffered device steps: donate the state operand so XLA writes
+# the step's output state INTO the input's buffers (no state-sized
+# alloc+copy per dispatch) while the freshly packed words ride their own
+# host buffer — dispatch is async, so the device consumes buffer N while
+# the host packs N+1. Every caller rebinds the state reference on
+# return, which is exactly what donation requires. XLA:CPU doesn't
+# implement donation (it would warn once per compile and ignore it), so
+# gate it — but probe the backend LAZILY, at the first dispatch: probing
+# at import would initialize the JAX backend before consumers
+# (tests/conftest.py, any host-only code path) get to configure
+# jax_platforms.
+@functools.lru_cache(maxsize=None)
+def _state_donation() -> tuple:
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
+class CompilePlan(NamedTuple):
+    """Resolved compilation strategy for one group/mesh shape.
+
+    ``step(states, words)`` -> (states, events, compact) — the grouped
+    fast-path dispatch; ``slide(states, (M,) int32 deltas)`` and
+    ``zero(states, (M,) uint8 mask)`` -> states — the rare-path window
+    ops. ``strategy`` records which compilation path each function took
+    (``jit`` / ``pjit`` / ``shard_map``) so surfaces can report it;
+    ``mesh_shape`` is ``()`` unsharded, ``(M,)`` member-sharded,
+    ``(M, V)`` on the 2-axis fabric."""
+
+    step: Callable
+    slide: Callable
+    zero: Callable
+    strategy: dict
+    mesh_shape: Tuple[int, ...]
+
+
+def _shardings(mesh: Mesh, spec_tree):
+    """PartitionSpec tree -> NamedSharding tree (PartitionSpec is itself
+    tuple-like on some jax versions, so mark it a leaf explicitly)."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _zero_body(states: q.VoteState, mask: jnp.ndarray) -> q.VoteState:
+    """Zero every leaf row of the masked members (a member MASK, not a
+    row index: a mask is trivially partitionable along the member axis,
+    a dynamic index is not)."""
+
+    def z(x):
+        hit = mask.reshape((-1,) + (1,) * (x.ndim - 1)) != 0
+        return jnp.where(hit, jnp.zeros((), x.dtype), x)
+
+    return jax.tree.map(z, states)
+
+
+def _slide_body(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
+    return jax.vmap(q.slide_state)(states, deltas)
+
+
+@functools.lru_cache(maxsize=None)
+def plan_for(mesh: Optional[Mesh], n_validators: int,
+             n_validator_rows: int, delta_cap: int) -> CompilePlan:
+    """Resolve the compilation plan for a :class:`VotePlaneGroup`.
+
+    ``n_validators`` is the REAL validator count (quorum thresholds);
+    ``n_validator_rows`` the padded row count the state tensors carry
+    (== ``n_validators`` unless the 2-axis fabric pads the validator
+    axis up to a mesh multiple — pad rows never receive votes, so the
+    psum'd counts are exact)."""
+    if mesh is None:
+        def step_impl(states, words):
+            msgs = q.unpack_words(words)
+            return jax.vmap(
+                lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
+            )(states, msgs)
+
+        return CompilePlan(
+            step=functools.partial(
+                jax.jit, donate_argnums=_state_donation())(step_impl),
+            slide=jax.jit(_slide_body),
+            zero=jax.jit(_zero_body),
+            strategy={"step": "jit", "slide": "jit", "zero": "jit"},
+            mesh_shape=())
+
+    axes = mesh.axis_names
+    member_axis = axes[0]
+    validator_axis = axes[1] if len(axes) > 1 else None
+    state_spec, row_spec, events_spec, vec_spec = q.member_sharded_specs(
+        member_axis, validator_axis)
+    compact_spec = q.compact_member_specs(member_axis)
+    mesh_shape = tuple(int(mesh.shape[a]) for a in axes)
+
+    if validator_axis is None:
+        def step_impl(states, words):
+            msgs = q.unpack_words(words)
+            return jax.vmap(
+                lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
+            )(states, msgs)
+    else:
+        v_shards = mesh_shape[1]
+        assert n_validator_rows % v_shards == 0, (n_validator_rows, v_shards)
+        v_local = n_validator_rows // v_shards
+
+        def step_impl(states, words):
+            msgs = q.unpack_words(words)
+            offset = (lax.axis_index(validator_axis).astype(jnp.int32)
+                      * v_local)
+            return jax.vmap(
+                lambda s, m: q.step_compact_local(
+                    s, m, n_validators, delta_cap, offset, v_local,
+                    validator_axis)
+            )(states, msgs)
+
+    step = functools.partial(jax.jit, donate_argnums=_state_donation())(
+        q.shard_map_compat(step_impl, mesh=mesh,
+                           in_specs=(state_spec, row_spec),
+                           out_specs=(state_spec, events_spec,
+                                      compact_spec)))
+
+    state_sh = _shardings(mesh, state_spec)
+    vec_sh = _shardings(mesh, vec_spec)
+    slide = jax.jit(_slide_body, in_shardings=(state_sh, vec_sh),
+                    out_shardings=state_sh)
+    zero = jax.jit(_zero_body, in_shardings=(state_sh, vec_sh),
+                   out_shardings=state_sh)
+    return CompilePlan(
+        step=step, slide=slide, zero=zero,
+        strategy={"step": "shard_map", "slide": "pjit", "zero": "pjit"},
+        mesh_shape=mesh_shape)

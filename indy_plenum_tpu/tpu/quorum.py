@@ -49,6 +49,31 @@ PREPARE = 1
 COMMIT = 2
 CHECKPOINT = 3
 
+# the quorum fabric's canonical mesh axis names: axis 0 shards the
+# member axis M (= nodes x instances, independent planes), axis 1 — when
+# present — shards each plane's validator axis N (quorum counts then
+# ride the ICI as psum). A 1-axis ("members",) mesh is the PR 4 layout.
+FABRIC_AXES = ("members", "validators")
+
+
+def make_fabric_mesh(devices, shape) -> Mesh:
+    """Build the quorum-fabric mesh from a device list and a 1- or 2-dim
+    ``shape`` tuple: ``(8,)`` -> member-sharded only, ``(4, 2)`` -> the
+    member x validator grid. The ONE constructor every surface
+    (bench/profile/chaos/budget-gate/dryrun) builds its mesh through, so
+    the axis names stay the :data:`FABRIC_AXES` contract."""
+    shape = tuple(int(d) for d in shape)
+    if not 1 <= len(shape) <= 2 or any(d < 1 for d in shape):
+        raise ValueError(f"fabric mesh shape must be (M,) or (M, V): {shape}")
+    n_dev = 1
+    for d in shape:
+        n_dev *= d
+    if len(devices) < n_dev:
+        raise ValueError(
+            f"fabric mesh {shape} needs {n_dev} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n_dev]).reshape(shape),
+                FABRIC_AXES[:len(shape)])
+
 
 def shard_map_compat(fn, mesh, in_specs, out_specs):
     """``jax.shard_map`` across jax versions: the top-level alias (with
@@ -231,6 +256,31 @@ def _delta_slots(newly: jnp.ndarray, cap: int):
     return jnp.sort(idx)[:cap], jnp.sum(newly).astype(jnp.int32)
 
 
+def compact_from_events(
+    state: VoteState, events: QuorumEvents, delta_cap: int,
+) -> Tuple[VoteState, QuorumEvents, CompactEvents]:
+    """Fold one step's :class:`QuorumEvents` into :class:`CompactEvents`
+    + the carried fast-path state (``prepared_acked``/``frontier``) —
+    the shared tail of :func:`step_compact` and the validator-sharded
+    :func:`step_compact_local` (whose events are already psum'd, so this
+    runs replicated across the validator axis and every shard emits the
+    identical compact block)."""
+    new_prep = events.prepared & ~state.prepared_acked.astype(bool)
+    p_slots, p_n = _delta_slots(new_prep, delta_cap)
+    c_slots, c_n = _delta_slots(events.newly_ordered, delta_cap)
+    lead = jnp.sum(jnp.cumprod(events.ordered.astype(jnp.int32)))
+    frontier = jnp.maximum(state.frontier, lead.astype(jnp.int32))
+    state = state._replace(
+        prepared_acked=events.prepared.astype(jnp.uint8),
+        frontier=frontier)
+    compact = CompactEvents(
+        frontier=frontier,
+        new_prepared=p_slots, n_prepared=p_n,
+        new_committed=c_slots, n_committed=c_n,
+        stable=events.stable_checkpoints.astype(jnp.uint8))
+    return state, events, compact
+
+
 def step_compact(
     state: VoteState, msgs: MsgBatch, n_validators: int,
     delta_cap: int = ORDER_DELTA_CAP,
@@ -250,20 +300,54 @@ def step_compact(
     mask (pp + prepare cert + commit cert), monotone within the epoch —
     the host's in-order delivery point is ``h + frontier``."""
     state, events = step(state, msgs, n_validators)
-    new_prep = events.prepared & ~state.prepared_acked.astype(bool)
-    p_slots, p_n = _delta_slots(new_prep, delta_cap)
-    c_slots, c_n = _delta_slots(events.newly_ordered, delta_cap)
-    lead = jnp.sum(jnp.cumprod(events.ordered.astype(jnp.int32)))
-    frontier = jnp.maximum(state.frontier, lead.astype(jnp.int32))
-    state = state._replace(
-        prepared_acked=events.prepared.astype(jnp.uint8),
-        frontier=frontier)
-    compact = CompactEvents(
-        frontier=frontier,
-        new_prepared=p_slots, n_prepared=p_n,
-        new_committed=c_slots, n_committed=c_n,
-        stable=events.stable_checkpoints.astype(jnp.uint8))
-    return state, events, compact
+    return compact_from_events(state, events, delta_cap)
+
+
+def step_compact_local(
+    state: VoteState, msgs: MsgBatch, n_validators: int, delta_cap: int,
+    row_offset: jnp.ndarray, local_rows: int, axis_name: str,
+) -> Tuple[VoteState, QuorumEvents, CompactEvents]:
+    """:func:`step_compact` for a validator-SHARDED shard_map body (the
+    2-axis quorum fabric): each shard scatters only the votes whose
+    sender falls in its local row block ``[row_offset, row_offset +
+    local_rows)`` and quorum counts reduce with ``psum`` over
+    ``axis_name`` — the ICI is the vote bus. ``n_validators`` stays the
+    REAL validator count (thresholds must not see pad rows; pad rows
+    never receive votes, so the psum'd counts are exact)."""
+    state = _scatter_local(state, msgs, row_offset, local_rows)
+    state, events = _quorum_events(state, n_validators, axis_name)
+    return compact_from_events(state, events, delta_cap)
+
+
+def slide_state(state: VoteState, delta: jnp.ndarray) -> VoteState:
+    """Roll the slot axis left by ``delta`` and zero the vacated columns
+    (the checkpoint-stabilization window slide — the ONE definition both
+    the standalone plane and every grouped compile plan jit)."""
+    s = state.prepare_votes.shape[1]
+    cols = jnp.arange(s)
+    keep = cols < (s - delta)  # after roll, tail columns are new/empty
+
+    def roll1(x):
+        return jnp.where(keep, jnp.roll(x, -delta), 0)
+
+    def roll2(x):
+        return jnp.where(keep[None, :], jnp.roll(x, -delta, axis=1), 0)
+
+    return VoteState(
+        preprepare_seen=roll1(state.preprepare_seen),
+        prepare_votes=roll2(state.prepare_votes),
+        commit_votes=roll2(state.commit_votes),
+        # delta == 0 must be a strict identity (the vmapped group slide
+        # passes 0 for every member but the one actually sliding)
+        checkpoint_votes=jnp.where(delta > 0, 0,
+                                   state.checkpoint_votes),
+        ordered=roll1(state.ordered),
+        prepared_acked=roll1(state.prepared_acked),
+        # the in-order frontier slides with the window (host mirrors
+        # apply the identical clamp so device and host never disagree)
+        frontier=jnp.maximum(
+            state.frontier - delta, 0).astype(jnp.int32),
+    )
 
 
 def compact_member_specs(axis: str):
@@ -324,20 +408,24 @@ def make_sharded_step(mesh: Mesh, n_validators: int, axis: str = "validators"):
     return jax.jit(shard_fn)
 
 
-def member_sharded_specs(axis: str):
+def member_sharded_specs(axis: str, validator_axis: Optional[str] = None):
     """PartitionSpecs for a GROUP step whose LEADING axis is the member
     axis M (= nodes x instances), sharded over mesh axis ``axis``.
 
-    Every VoteState/QuorumEvents leaf gains a leading member dim and
-    nothing below it is sharded — members are independent planes, so the
-    grouped step needs no cross-member collectives and each chip keeps
-    its member shard entirely local. Returns
+    Every VoteState/QuorumEvents leaf gains a leading member dim.
+    Members are independent planes, so the grouped step needs no
+    cross-member collectives and each chip keeps its member shard
+    entirely local. With ``validator_axis`` (the 2-axis quorum fabric)
+    the per-member vote matrices additionally shard their validator row
+    axis over it — quorum counts then reduce with ``psum`` over that
+    axis, and everything derived from the psum'd counts (events, compact
+    deltas, the scatter words) stays replicated across it. Returns
     ``(state_spec, row_spec, events_spec, vec_spec)`` where ``row_spec``
     covers (M, B) operands (the packed scatter words) and ``vec_spec``
     covers (M,) operands (slide deltas, reset masks)."""
     vec = P(axis)
     row = P(axis, None)
-    mat = P(axis, None, None)
+    mat = P(axis, validator_axis, None)
     state_spec = VoteState(
         preprepare_seen=row,
         prepare_votes=mat,

@@ -1,0 +1,143 @@
+"""Ring-collective vote-plane exchange: device-to-device plane migration.
+
+The scale-out quorum fabric shards the member axis across a mesh; when
+the pool's membership or load shifts (a hot shard, a rebalance after
+view change), whole member vote planes must MOVE between shards. The
+host path for that is a gather + re-put — two PCIe crossings per plane.
+This module prototypes the device-to-device path: every member shard
+hands its block of planes to its ring neighbor over the interconnect,
+no host hop.
+
+Two implementations, one contract (``ring_shift_planes``):
+
+- **reference** (any backend): ``shard_map`` + ``lax.ppermute`` — the
+  collective XLA already knows. This is the semantics oracle and what
+  CPU meshes (tests, virtual-device dryruns) execute.
+- **pallas** (REAL TPU only, guarded): a ``pltpu.make_async_remote_copy``
+  ring permute (SNIPPETS.md [1] / the Pallas ring-collective pattern) —
+  each device RDMAs its local block straight into its right neighbor's
+  buffer with send/recv DMA semaphores. Off TPU the builder raises
+  ``NotImplementedError`` and callers fall back to the reference path;
+  the kernel is the template the real-hardware run compiles.
+
+Both shift the MEMBER-shard blocks by one ring step along mesh axis 0;
+state carried per member (h, mirrors) must be rotated by the host-side
+caller — this module moves the device tensors only.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import quorum as q
+
+
+def _member_specs(state_like, axis: str, validator_axis=None):
+    """Per-leaf member-sharded PartitionSpecs matching the group layout
+    (ndim 3 leaves carry the validator axis under the 2-axis fabric)."""
+    return jax.tree.map(
+        lambda x: P(axis, validator_axis, None) if x.ndim == 3
+        else P(axis, *([None] * (x.ndim - 1))), state_like)
+
+
+def ring_shift_reference(states, mesh: Mesh, shift: int = 1):
+    """Rotate every member-shard block ``shift`` steps to the RIGHT
+    along mesh axis 0 via ``lax.ppermute`` — the backend-portable
+    reference for the pallas kernel below. ``states`` is any pytree of
+    member-leading arrays sharded over ``mesh`` (a
+    :class:`~indy_plenum_tpu.tpu.quorum.VoteState` stack or a single
+    tensor)."""
+    axis = mesh.axis_names[0]
+    validator_axis = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    n_shards = int(mesh.shape[axis])
+    perm = [(i, (i + shift) % n_shards) for i in range(n_shards)]
+    specs = _member_specs(states, axis, validator_axis)
+
+    def impl(s):
+        return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), s)
+
+    return jax.jit(q.shard_map_compat(
+        impl, mesh=mesh, in_specs=(specs,), out_specs=specs))(states)
+
+
+def _ring_kernel(input_ref, output_ref, send_sem, recv_sem):
+    """One ring step: RDMA the local block to the right neighbor (the
+    SNIPPETS.md [1] permute, with the neighbor computed from the mesh
+    position instead of baked in)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    my_idx = lax.axis_index("members")
+    n = lax.axis_size("members")
+    right = ((my_idx + 1) % n,)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=input_ref,
+        dst_ref=output_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_ring_fn(mesh: Mesh, shape, dtype):
+    """Compile the guarded pallas ring permute for one block shape."""
+    if jax.default_backend() != "tpu":
+        raise NotImplementedError(
+            "pallas ring exchange needs a real TPU backend "
+            f"(have {jax.default_backend()!r}); use ring_shift_reference")
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    axis = mesh.axis_names[0]
+
+    def wrapper(x):
+        return pl.pallas_call(
+            _ring_kernel,
+            out_shape=jax.ShapeDtypeStruct(shape, dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+            compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+        )(x)
+
+    # shape is the per-device BLOCK (member dim included), so the spec
+    # has exactly len(shape) entries: the sharded member axis + a None
+    # per remaining dim
+    spec = P(axis, *([None] * (len(shape) - 1)))
+    return jax.jit(q.shard_map_compat(
+        wrapper, mesh=mesh, in_specs=(spec,), out_specs=spec))
+
+
+def ring_shift_pallas(x, mesh: Mesh):
+    """One right-shift of a member-sharded array's blocks over the TPU
+    interconnect (device-to-device RDMA, no host hop). Guarded: raises
+    ``NotImplementedError`` off real TPU hardware."""
+    block = (int(x.shape[0]) // int(mesh.shape[mesh.axis_names[0]]),
+             *map(int, x.shape[1:]))
+    return _pallas_ring_fn(mesh, block, x.dtype)(x)
+
+
+def ring_shift_planes(states, mesh: Mesh, shift: int = 1):
+    """Migrate member vote-plane blocks ``shift`` ring steps along mesh
+    axis 0, device-to-device where the hardware allows it: the pallas
+    RDMA path on a real TPU (single-step shifts), the ppermute reference
+    everywhere else. Semantics are identical by construction — the
+    reference IS the oracle the pallas path is tested against on
+    hardware."""
+    if shift % int(mesh.shape[mesh.axis_names[0]]) == 0:
+        return states
+    if shift == 1 and jax.default_backend() == "tpu" \
+            and len(mesh.axis_names) == 1:
+        try:
+            return jax.tree.map(
+                lambda x: ring_shift_pallas(x, mesh), states)
+        except NotImplementedError:
+            pass
+    return ring_shift_reference(states, mesh, shift)

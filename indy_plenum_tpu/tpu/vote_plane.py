@@ -147,24 +147,9 @@ class PlaneDeltas(NamedTuple):
     frontier: int
 
 
-# double-buffered device steps: donate the state operand so XLA writes
-# the step's output state INTO the input's buffers (no state-sized
-# alloc+copy per dispatch) while the freshly packed words ride their own
-# host buffer — dispatch is async, so the device consumes buffer N while
-# the host packs N+1. Every caller rebinds the state reference on return,
-# which is exactly what donation requires. XLA:CPU doesn't implement
-# donation (it would warn once per compile and ignore it), so gate it —
-# but probe the backend LAZILY, at the first dispatch: probing at import
-# would initialize the JAX backend before consumers (tests/conftest.py,
-# any host-only code path) get to configure jax_platforms.
-@functools.lru_cache(maxsize=None)
-def _state_donation() -> tuple:
-    return (0,) if jax.default_backend() != "cpu" else ()
-
-
-@functools.partial(jax.jit, static_argnums=(2,))
-def _step(state: q.VoteState, msgs: q.MsgBatch, n_validators: int):
-    return q.step(state, msgs, n_validators)
+# the donation gate lives with the compilation plans now (one definition
+# for the standalone jits here AND every plan compile_plan.py builds)
+from .compile_plan import _state_donation, plan_for  # noqa: E402
 
 
 @functools.lru_cache(maxsize=None)
@@ -182,42 +167,9 @@ def _step_words(state: q.VoteState, words, n_validators: int):
     return _jit_step_words()(state, words, n_validators)
 
 
-def _slide_core(state: q.VoteState, delta: jnp.ndarray) -> q.VoteState:
-    """Roll the slot axis left by ``delta`` and zero the vacated columns."""
-    s = state.prepare_votes.shape[1]
-    cols = jnp.arange(s)
-    keep = cols < (s - delta)  # after roll, tail columns are new/empty
-
-    def roll1(x):
-        return jnp.where(keep, jnp.roll(x, -delta), 0)
-
-    def roll2(x):
-        return jnp.where(keep[None, :], jnp.roll(x, -delta, axis=1), 0)
-
-    return q.VoteState(
-        preprepare_seen=roll1(state.preprepare_seen),
-        prepare_votes=roll2(state.prepare_votes),
-        commit_votes=roll2(state.commit_votes),
-        # delta == 0 must be a strict identity (the vmapped group slide
-        # passes 0 for every member but the one actually sliding)
-        checkpoint_votes=jnp.where(delta > 0, 0,
-                                   state.checkpoint_votes),
-        ordered=roll1(state.ordered),
-        prepared_acked=roll1(state.prepared_acked),
-        # the in-order frontier slides with the window (host mirrors
-        # apply the identical clamp so device and host never disagree)
-        frontier=jnp.maximum(
-            state.frontier - delta, 0).astype(jnp.int32),
-    )
-
-
-_slide = jax.jit(_slide_core)
-
-
-@functools.partial(jax.jit, static_argnums=(2,))
-def _group_step(states: q.VoteState, msgs: q.MsgBatch, n_validators: int):
-    """Vmapped step over a leading member axis: M planes, ONE dispatch."""
-    return jax.vmap(lambda s, m: q.step(s, m, n_validators))(states, msgs)
+# the window-slide core lives in tpu.quorum (slide_state) so the
+# compilation plans can jit it without a circular import
+_slide = jax.jit(q.slide_state)
 
 
 @functools.lru_cache(maxsize=None)
@@ -236,102 +188,9 @@ def _step_words_compact_impl(state: q.VoteState, words, n_validators: int,
 def _step_words_compact(state: q.VoteState, words, n_validators: int,
                         delta_cap: int):
     """Single-plane ordering fast path: the standalone (deployed-Node)
-    analog of :func:`_group_step_compact` — quorum eval + frontier
+    analog of the grouped compile-plan step — quorum eval + frontier
     advance on device, compact deltas read back."""
     return _jit_step_words_compact()(state, words, n_validators, delta_cap)
-
-
-@functools.lru_cache(maxsize=None)
-def _jit_group_step_compact():
-    return functools.partial(
-        jax.jit, static_argnums=(2, 3),
-        donate_argnums=_state_donation())(_group_step_compact_impl)
-
-
-def _group_step_compact_impl(states: q.VoteState, words, n_validators: int,
-                             delta_cap: int):
-    msgs = q.unpack_words(words)
-    return jax.vmap(
-        lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
-    )(states, msgs)
-
-
-def _group_step_compact(states: q.VoteState, words, n_validators: int,
-                        delta_cap: int):
-    """The ordering fast path's group step: ONE dispatch scatters every
-    member's votes, folds counts into quorum verdicts, advances each
-    member's in-order frontier ON DEVICE and emits per-member
-    :class:`~indy_plenum_tpu.tpu.quorum.CompactEvents` — what the host
-    reads back is O(newly certified + frontier), not the (M, N, S) event
-    matrix. Full events are also returned but stay device-resident
-    unless the host explicitly fetches them (overflow / host_eval /
-    diagnostics)."""
-    return _jit_group_step_compact()(states, words, n_validators, delta_cap)
-
-
-@jax.jit
-def _group_slide(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
-    return jax.vmap(_slide_core)(states, deltas)
-
-
-@jax.jit
-def _group_zero_member(states: q.VoteState, member: jnp.ndarray) -> q.VoteState:
-    return jax.tree.map(lambda x: x.at[member].set(0), states)
-
-
-@functools.lru_cache(maxsize=None)
-def _sharded_group_fns(mesh, axis: str, n_validators: int,
-                       delta_cap: int = q.ORDER_DELTA_CAP):
-    """shard_map'd (step, slide, zero) for a member-sharded group.
-
-    The member axis M is split across ``mesh``; inside each shard the
-    PLAIN per-member step/slide runs vmapped over the local rows —
-    members are independent planes, so no cross-member collectives exist
-    and XLA keeps every shard's tensors on its own chip. This is the
-    explicit-SPMD successor of the PR 2 auto-partitioned mesh path: the
-    sharding of every operand and result is stated, not inferred, so the
-    grouped dispatch can never silently fall back to an all-gather.
-
-    The step is jitted with the state operand donated (same PR 3
-    double-buffer contract as the unsharded `_group_step_compact`, gated
-    off XLA:CPU) and ``zero`` takes an (M,) member MASK instead of a
-    scalar index — a dynamic row index cannot be resolved against a
-    shard-local block, a mask shards trivially.
-    """
-    state_spec, row_spec, events_spec, vec_spec = q.member_sharded_specs(axis)
-    compact_spec = q.compact_member_specs(axis)
-
-    def step_impl(states, words):
-        msgs = q.unpack_words(words)
-        return jax.vmap(
-            lambda s, m: q.step_compact(s, m, n_validators, delta_cap)
-        )(states, msgs)
-
-    step = functools.partial(jax.jit, donate_argnums=_state_donation())(
-        q.shard_map_compat(step_impl, mesh=mesh,
-                           in_specs=(state_spec, row_spec),
-                           out_specs=(state_spec, events_spec,
-                                      compact_spec)))
-
-    def slide_impl(states, deltas):
-        return jax.vmap(_slide_core)(states, deltas)
-
-    slide = jax.jit(q.shard_map_compat(
-        slide_impl, mesh=mesh, in_specs=(state_spec, vec_spec),
-        out_specs=state_spec))
-
-    def zero_impl(states, mask):
-        def z(x):
-            hit = mask.reshape((-1,) + (1,) * (x.ndim - 1)) != 0
-            return jnp.where(hit, jnp.zeros((), x.dtype), x)
-
-        return jax.tree.map(z, states)
-
-    zero = jax.jit(q.shard_map_compat(
-        zero_impl, mesh=mesh, in_specs=(state_spec, vec_spec),
-        out_specs=state_spec))
-
-    return step, slide, zero
 
 
 class DeviceVotePlane:
@@ -691,16 +550,23 @@ class VotePlaneGroup:
                  adaptive_ladder: bool = False,
                  host_eval: bool = False,
                  delta_cap: Optional[int] = None):
-        """``mesh``: an optional :class:`jax.sharding.Mesh` with one axis;
-        the member axis of every vote tensor is sharded across it via
-        ``q.shard_map_compat``, so one pod's chips split the pool's
-        planes and the grouped step runs explicit SPMD (members are
-        independent — no cross-member collectives are needed; each
-        chip's shard stays local). ``n_members`` is padded UP to a
-        multiple of the mesh size: the trailing pad rows are real (zero)
-        planes with no member view — they never receive votes, and
-        occupancy accounting excludes them, so a 10-member pool on an
-        8-device mesh costs two idle rows, not a ValueError.
+        """``mesh``: an optional :class:`jax.sharding.Mesh` with one or
+        two axes (build it with ``q.make_fabric_mesh``). Axis 0 shards
+        the member axis of every vote tensor, so one pod's chips split
+        the pool's planes and the grouped step runs explicit SPMD
+        (members are independent — no cross-member collectives are
+        needed; each chip's member shard stays local). Axis 1 — the
+        2-axis quorum fabric — additionally shards each plane's
+        VALIDATOR axis: quorum counts reduce with ``psum`` over it (the
+        ICI is the vote bus), which is what lets n ≫ 100 pools keep
+        per-chip vote tensors flat. Both axes pad UP to their mesh
+        multiple: trailing pad member rows are real (zero) planes with
+        no member view and pad validator rows never receive votes —
+        neither perturbs counts, and occupancy accounting excludes
+        them, so a 10-member pool on an 8-device mesh costs two idle
+        rows, not a ValueError. HOW each step function compiles for the
+        mesh shape (jit / pjit-with-shardings / shard_map) is resolved
+        by :func:`~indy_plenum_tpu.tpu.compile_plan.plan_for`.
         ``adaptive_ladder`` hands the padded flush width to an
         :class:`AdaptiveLadder` (learned per-pool top rung).
 
@@ -722,40 +588,71 @@ class VotePlaneGroup:
         self._n_chk = n_checkpoints
         self.host_eval = host_eval
         self._delta_cap = int(delta_cap) if delta_cap else q.ORDER_DELTA_CAP
-        proto = q.init_state(self._n, log_size, n_checkpoints)
         self._mesh = mesh
         self._sharding = None
-        self._sharded_fns = None
-        self._n_shards = 1
+        self._m_shards = 1  # member-axis blocks (axis 0 of the mesh)
+        self._v_shards = 1  # validator-axis blocks (axis 1, 2-axis fabric)
         self._shard_rows = n_members
         self._m_pad = n_members
+        self._v_rows = self._n
+        self._n_pad = self._n
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            axis = mesh.axis_names[0]
-            self._n_shards = int(mesh.devices.size)
-            self._shard_rows = -(-n_members // self._n_shards)  # ceil
-            self._m_pad = self._shard_rows * self._n_shards
-            # member axis sharded; everything below it stays local
-            self._sharding = lambda ndim: NamedSharding(
-                mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
-            self._sharded_fns = _sharded_group_fns(mesh, axis, self._n,
-                                                   self._delta_cap)
-            # shard index -> owning device, resolved ONCE from the
+            axes = mesh.axis_names
+            member_axis = axes[0]
+            validator_axis = axes[1] if len(axes) > 1 else None
+            self._m_shards = int(mesh.shape[member_axis])
+            if validator_axis is not None:
+                self._v_shards = int(mesh.shape[validator_axis])
+            # BOTH axes pad up to their mesh multiple: pad member rows
+            # are zero planes with no member view, pad validator rows
+            # never receive votes (senders index the real validators) —
+            # so neither perturbs quorum counts or occupancy capacity
+            self._shard_rows = -(-n_members // self._m_shards)  # ceil
+            self._m_pad = self._shard_rows * self._m_shards
+            self._v_rows = -(-self._n // self._v_shards)
+            self._n_pad = self._v_rows * self._v_shards
+            # member axis sharded over axis 0; the per-member vote
+            # matrices (ndim 3) additionally shard their validator row
+            # axis over axis 1 when the fabric runs 2-axis
+            specs = {
+                1: PartitionSpec(member_axis),
+                2: PartitionSpec(member_axis, None),
+                3: PartitionSpec(member_axis, validator_axis, None),
+            }
+            self._sharding = lambda ndim: NamedSharding(mesh, specs[ndim])
+            # member block -> owning device(s), resolved ONCE from the
             # sharding's own index map (the row-block assignment is
             # static per mesh; _stage_scatter must not recompute it —
-            # or hop through the default device — per flush)
+            # or hop through the default device — per flush). Under the
+            # 2-axis fabric each member block is REPLICATED across its
+            # validator-axis devices, so a block owns several.
             imap = self._sharding(2).devices_indices_map((self._m_pad, 1))
-            self._shard_devices = [None] * self._n_shards
+            self._shard_devices = [[] for _ in range(self._m_shards)]
             for dev, idx in imap.items():
                 self._shard_devices[
-                    (idx[0].start or 0) // self._shard_rows] = dev
-        # real (non-pad) member rows per shard: the capacity denominator
-        # for per-shard occupancy — pad rows can never hold votes and
-        # must not dilute the governor's signal
+                    (idx[0].start or 0) // self._shard_rows].append(dev)
+        # occupancy grid: one cell per (member block x validator block) —
+        # flat index i * v_shards + j; with one validator shard this is
+        # exactly the PR 4 per-member-shard series
+        self._n_shards = self._m_shards * self._v_shards
+        # the compilation plan (tpu.compile_plan): HOW step/slide/zero
+        # compile for this mesh shape — jit / pjit-with-shardings /
+        # shard_map — is decided there, in one place
+        self._plan = plan_for(mesh, self._n, self._n_pad, self._delta_cap)
+        # real (non-pad) member rows per member block: the capacity
+        # denominator for per-shard occupancy — pad rows can never hold
+        # votes and must not dilute the governor's signal
         self._real_rows = [
             min(max(n_members - si * self._shard_rows, 0), self._shard_rows)
-            for si in range(self._n_shards)]
+            for si in range(self._m_shards)]
+        # real validator rows per validator block (2-axis fabric): cell
+        # capacity is apportioned by each block's share of real senders
+        self._v_real = [
+            min(max(self._n - vj * self._v_rows, 0), self._v_rows)
+            for vj in range(self._v_shards)]
+        proto = q.init_state(self._n_pad, log_size, n_checkpoints)
         self._states = jax.tree.map(
             lambda x: jnp.zeros((self._m_pad,) + x.shape, x.dtype), proto)
         if self._sharding is not None:
@@ -789,10 +686,15 @@ class VotePlaneGroup:
         self._dev_events: Optional[q.QuorumEvents] = None
         # readback accounting: bytes actually crossing the device->host
         # boundary per absorb, and how many absorbs were overlapped
-        # (consumed a step dispatched by an EARLIER flush call)
+        # (consumed a step dispatched by an EARLIER flush call). On a
+        # mesh the device-eval absorb runs PER MEMBER SHARD (one compact
+        # block per shard, pipelined against the next shard's scatter
+        # staging), so ``readbacks`` counts shard blocks there and the
+        # per-shard byte series makes a hot shard visible.
         self.readback_bytes_total = 0
         self.readbacks = 0
         self.readbacks_overlapped = 0
+        self.readback_bytes_per_shard = [0] * self._m_shards
         self._flush_seq = 0
         self.flushes = 0
         # occupancy counters (see DeviceVotePlane): per-tick deltas feed
@@ -805,6 +707,18 @@ class VotePlaneGroup:
         # cannot mask it behind the pool-wide average
         self.flush_votes_per_shard = [0] * self._n_shards
         self.flush_capacity_per_shard = [0] * self._n_shards
+        # scale-out flush chunking: a full 3PC wave buffers ~2N votes
+        # per member (N prepares + N commits), so past n=64 the static
+        # 128-wide top rung makes every tick chain ceil(2N/128) grouped
+        # dispatches and dispatches/ordered-batch GROWS with the pool —
+        # the fabric's flat-scaling claim dies (measured: 7.5 vs 1.5 at
+        # n=256 vs n=64 before this). The group's chunk limit holds one
+        # wave, pow2 (each rung stays one cached compilation), and
+        # never drops below the static FLUSH_BATCH — pools with n<=64
+        # keep the PR 2 ladder bit-for-bit.
+        self.flush_batch = FLUSH_BATCH
+        while self.flush_batch < 2 * self._n and self.flush_batch < 4096:
+            self.flush_batch *= 2
         # reusable host scatter staging (UNSHARDED path only): one
         # preallocated (M, B) buffer per ladder rung — the hot loop
         # stops paying an (M, B) np.zeros allocation per flush. Reuse is
@@ -846,14 +760,31 @@ class VotePlaneGroup:
 
     @property
     def shards(self) -> int:
-        """Mesh shard count (1 when unsharded)."""
+        """Occupancy-grid cell count == mesh device count (1 unsharded;
+        member blocks x validator blocks on the 2-axis fabric)."""
         return self._n_shards
+
+    @property
+    def mesh_shape(self) -> tuple:
+        """() unsharded, (M,) member-sharded, (M, V) on the 2-axis
+        member x validator fabric — the shape every surface reports
+        alongside ``shards``."""
+        return self._plan.mesh_shape
+
+    @property
+    def compile_strategy(self) -> dict:
+        """Which compilation path each step function took (the resolved
+        :class:`~indy_plenum_tpu.tpu.compile_plan.CompilePlan`)."""
+        return dict(self._plan.strategy)
 
     @property
     def shard_occupancy(self) -> List[float]:
         """Cumulative per-shard occupancy (scattered votes / real-row
         capacity) — THE definition every surface reports (bench, budget
-        gate, profile, dryrun)."""
+        gate, profile, dryrun). On the 2-axis fabric the list is the
+        flattened grid (cell i*V + j = member block i x validator block
+        j, capacity apportioned by block j's share of real senders), so
+        a hot validator block shows exactly like a hot member block."""
         return [round(v / c, 4) if c else 0.0
                 for v, c in zip(self.flush_votes_per_shard,
                                 self.flush_capacity_per_shard)]
@@ -865,20 +796,34 @@ class VotePlaneGroup:
         return "host" if self.host_eval else "device"
 
     def _absorb_results(self, results: list, overlapped: bool) -> None:
-        """Fold one flush's chained steps into the host snapshot.
+        """Fold one flush's chained steps into the host snapshot (all
+        shard blocks at once — the pipelined flush instead drives
+        :meth:`_absorb_blocks` interleaved with its scatter staging)."""
+        for _ in self._absorb_blocks(results, overlapped):
+            pass
+
+    def _absorb_blocks(self, results: list, overlapped: bool):
+        """Generator folding one flush's chained steps into the host
+        snapshot, one member-shard block at a time (yielding between
+        blocks so the pipelined flush can overlap each block's absorb
+        with the NEXT shard's scatter staging).
 
         host_eval mode: ONE bundled full-matrix transfer (the last
-        chained step's events are cumulative). Device-eval mode: each
-        step's CompactEvents deltas are fetched and folded into the
-        mirrors — O(newly certified + frontier) bytes, with a full-
-        events fallback only for a member whose per-step delta
-        overflowed the fixed capacity. The ``flush.readback`` span's
-        ``bytes`` arg is the fast path's acceptance contract."""
-        args = ({"bytes": 0, "overlapped": overlapped}
-                if self.trace.enabled else None)
-        with self.trace.span("flush.readback", args=args) \
-                if self.trace.enabled else _NO_SPAN:
-            if self.host_eval:
+        chained step's events are cumulative) — the gather-all fallback.
+        Device-eval mode: each step's CompactEvents deltas are fetched
+        PER MEMBER SHARD (one addressable block per shard; under the
+        2-axis fabric the validator-axis replicas are never fetched) and
+        folded into the mirrors — O(newly certified + frontier) bytes,
+        with a full-events fallback only for a member whose per-step
+        delta overflowed the fixed capacity. Each block is one
+        ``flush.readback`` span (``shard`` arg on a mesh) and one
+        ``readbacks`` count — the fast path's acceptance contract."""
+        trace_on = self.trace.enabled
+        if self.host_eval:
+            args = ({"bytes": 0, "overlapped": overlapped}
+                    if trace_on else None)
+            with self.trace.span("flush.readback", args=args) \
+                    if trace_on else _NO_SPAN:
                 events = results[-1][0]
                 (self._host_prepared, self._host_prepare_counts,
                  self._host_commit_counts,
@@ -891,48 +836,95 @@ class VotePlaneGroup:
                 bytes_n = sum(a.nbytes for a in (
                     self._host_prepared, self._host_prepare_counts,
                     self._host_commit_counts, self._host_stable))
-            else:
-                bytes_n = 0
-                for events, compact in results:
-                    bytes_n += self._apply_compact(events, compact)
-                self._host_prepared = self._mir_prepared
-                self._host_commit_ok = self._mir_commit_ok
-                self._host_stable = self._mir_stable
-                self._host_prepare_counts = None
-                self._host_commit_counts = None
-            if args is not None:
-                args["bytes"] = bytes_n
+                if args is not None:
+                    args["bytes"] = bytes_n
+            self.readback_bytes_total += bytes_n
+            self.readbacks += 1
+            if overlapped:
+                self.readbacks_overlapped += 1
+            self.metrics.add_event(MetricsName.DEVICE_READBACK_BYTES,
+                                   bytes_n)
+        else:
+            sharded = self._mesh is not None
+            blocks = self._m_shards if sharded else 1
+            for si in range(blocks):
+                args = ({"bytes": 0, "overlapped": overlapped}
+                        if trace_on else None)
+                if args is not None and sharded:
+                    args["shard"] = si
+                with self.trace.span("flush.readback", args=args) \
+                        if trace_on else _NO_SPAN:
+                    bytes_n = 0
+                    for events, compact in results:
+                        bytes_n += self._apply_compact_block(
+                            events, compact, si if sharded else None)
+                    if args is not None:
+                        args["bytes"] = bytes_n
+                self.readback_bytes_total += bytes_n
+                if sharded:
+                    self.readback_bytes_per_shard[si] += bytes_n
+                self.readbacks += 1
+                if overlapped:
+                    self.readbacks_overlapped += 1
+                self.metrics.add_event(MetricsName.DEVICE_READBACK_BYTES,
+                                       bytes_n)
+                yield si
+            self._host_prepared = self._mir_prepared
+            self._host_commit_ok = self._mir_commit_ok
+            self._host_stable = self._mir_stable
+            self._host_prepare_counts = None
+            self._host_commit_counts = None
         self._dev_events = results[-1][0]
-        self.readback_bytes_total += bytes_n
-        self.readbacks += 1
-        if overlapped:
-            self.readbacks_overlapped += 1
-        self.metrics.add_event(MetricsName.DEVICE_READBACK_BYTES, bytes_n)
         self.metrics.add_event(MetricsName.DEVICE_READBACK_COMPACT,
                                0 if self.host_eval else 1)
         self.version += 1
 
-    def _apply_compact(self, events: q.QuorumEvents,
-                       compact: "q.CompactEvents") -> int:
-        """Fetch ONE step's compact deltas and fold them into the
-        mirrors + per-member delta accumulators; returns the bytes that
-        crossed the link. A member whose true delta count exceeds the
-        fixed capacity triggers one full-events fetch for this step and
-        reconciles by diffing against its mirror — same result, bigger
-        readback, deterministic (overflow is a pure function of the
-        seeded vote trajectory)."""
-        host = jax.device_get(compact)
-        bytes_n = sum(a.nbytes for a in host)
+    def _block_shard(self, arr, row_lo: int):
+        """The addressable shard of a member-sharded array whose member
+        rows start at ``row_lo`` (first validator-axis replica wins —
+        replicas are identical by the psum construction)."""
+        for sh in arr.addressable_shards:
+            if (sh.index[0].start or 0) == row_lo:
+                return sh
+        raise RuntimeError(f"no addressable shard at member row {row_lo}")
+
+    def _apply_compact_block(self, events: q.QuorumEvents,
+                             compact: "q.CompactEvents",
+                             si: Optional[int]) -> int:
+        """Fetch ONE step's compact deltas — the whole group (``si`` is
+        None, unsharded) or one member shard's block — and fold them
+        into the mirrors + per-member delta accumulators; returns the
+        bytes that crossed the link. A member whose true delta count
+        exceeds the fixed capacity triggers one full-events fetch (of
+        the same block) for this step and reconciles by diffing against
+        its mirror — same result, bigger readback, deterministic
+        (overflow is a pure function of the seeded vote trajectory)."""
+        if si is None:
+            lo = 0
+            host = jax.device_get(compact)
+        else:
+            lo = si * self._shard_rows
+            host = q.CompactEvents(*[
+                np.asarray(self._block_shard(leaf, lo).data)
+                for leaf in compact])
+        bytes_n = sum(np.asarray(a).nbytes for a in host)
         s = self._log_size
         cap = self._delta_cap
         members = self._members
-        n_real = len(members)
-        over_p = host.n_prepared > cap
-        over_c = host.n_committed > cap
+        rows = host.frontier.shape[0]
+        n_real = min(rows, len(members) - lo)  # pad rows hold nothing
+        over_p = np.asarray(host.n_prepared) > cap
+        over_c = np.asarray(host.n_committed) > cap
         full_prep = full_ord = None
-        if over_p.any() or over_c.any():
-            full_prep, full_ord = jax.device_get(
-                (events.prepared, events.ordered))
+        if over_p[:n_real].any() or over_c[:n_real].any():
+            if si is None:
+                full_prep, full_ord = jax.device_get(
+                    (events.prepared, events.ordered))
+            else:
+                full_prep = np.asarray(
+                    self._block_shard(events.prepared, lo).data)
+                full_ord = np.asarray(
+                    self._block_shard(events.ordered, lo).data)
             bytes_n += full_prep.nbytes + full_ord.nbytes
         # rows with anything to fold: slot lists are ascending and
         # S-padded, so row[0] < S iff the row is non-empty
@@ -940,28 +932,30 @@ class VotePlaneGroup:
             (host.new_prepared[:n_real, 0] < s)
             | (host.new_committed[:n_real, 0] < s)
             | over_p[:n_real] | over_c[:n_real])[0]
-        for mi in touched:
+        for r in touched:
+            mi = lo + int(r)
             member = members[mi]
-            if over_p[mi]:
-                new = np.nonzero(full_prep[mi]
+            if over_p[r]:
+                new = np.nonzero(full_prep[r]
                                  & ~self._mir_prepared[mi])[0]
             else:
-                row = host.new_prepared[mi]
+                row = host.new_prepared[r]
                 new = row[row < s]
             if new.size:
                 self._mir_prepared[mi, new] = True
                 member._delta_prepared.extend(int(x) for x in new)
-            if over_c[mi]:
-                new = np.nonzero(full_ord[mi]
+            if over_c[r]:
+                new = np.nonzero(full_ord[r]
                                  & ~self._mir_commit_ok[mi])[0]
             else:
-                row = host.new_committed[mi]
+                row = host.new_committed[r]
                 new = row[row < s]
             if new.size:
                 self._mir_commit_ok[mi, new] = True
                 member._delta_committed.extend(int(x) for x in new)
-        np.copyto(self._mir_stable, host.stable.astype(bool))
-        self._mir_frontier[:] = host.frontier
+        self._mir_stable[lo:lo + rows] = np.asarray(host.stable)\
+            .astype(bool)
+        self._mir_frontier[lo:lo + rows] = np.asarray(host.frontier)
         return bytes_n
 
     @property
@@ -970,20 +964,26 @@ class VotePlaneGroup:
         snapshot (pipelined mode) — quorum state may be newer on device."""
         return self._inflight is not None
 
-    def _stage_scatter(self, chunks: List[List[int]], shape: int):
+    def _stage_scatter(self, chunks: List[List[int]], shape: int,
+                       interleave=None):
         """Pack ``chunks`` into the rung's reusable host buffer(s) and
         hand the device its own copy (one vectorized row write per
         member; the staging buffers themselves are never reallocated).
 
-        Mesh mode stages PER SHARD: each shard's member rows land in a
+        Mesh mode stages PER SHARD: each member shard's rows land in a
         FRESH (rows, shape) buffer shipped straight to that shard's
-        device, then assemble into ONE global member-sharded array — no
+        device(s) (every validator-axis replica under the 2-axis
+        fabric), then assemble into ONE global member-sharded array — no
         host-side (M_pad, B) concat, no default-device hop, no
         device-side resharding on the flush path. Fresh buffers (not the
         unsharded path's reusable ones): a buffer that is never touched
         again has no aliasing hazard, so the device hand-off needs no
         forced copy — one allocation per shard replaces the
-        memset+fill+copy a reused buffer would cost."""
+        memset+fill+copy a reused buffer would cost. ``interleave``
+        (the pipelined per-shard flush) is advanced once per member
+        shard AFTER its device_put is in flight, so the previous tick's
+        readback block for one shard folds host-side while the next
+        shard's scatter rides the link."""
         if self._mesh is None:
             out = self._scatter_bufs.get(shape)
             if out is None:
@@ -997,46 +997,62 @@ class VotePlaneGroup:
             # for why asarray would alias and corrupt in-flight
             # dispatches
             return jnp.array(out)
-        bufs = [np.zeros((self._shard_rows, shape), np.uint32)
-                for _ in range(self._n_shards)]
-        for i, entries in enumerate(chunks):
-            if entries:
-                q.fill_words_row(
-                    bufs[i // self._shard_rows][i % self._shard_rows],
-                    entries)
-        arrs = [
-            jax.device_put(buf, dev)
-            for buf, dev in zip(bufs, self._shard_devices)]
+        arrs = []
+        for si in range(self._m_shards):
+            buf = np.zeros((self._shard_rows, shape), np.uint32)
+            base = si * self._shard_rows
+            for r in range(min(self._shard_rows, len(chunks) - base)):
+                if chunks[base + r]:
+                    q.fill_words_row(buf[r], chunks[base + r])
+            arrs.extend(jax.device_put(buf, dev)
+                        for dev in self._shard_devices[si])
+            if interleave is not None:
+                next(interleave, None)
         return jax.make_array_from_single_device_arrays(
             (self._m_pad, shape), self._sharding(2), arrs)
 
     def _run_group_step(self, words):
         """ONE grouped device step over the whole (padded) member axis —
-        shard_map'd under a mesh, plain vmapped jit otherwise. Returns
-        (new_states, events, compact): quorum eval AND the in-order
-        frontier advance happen inside this dispatch (the ordering fast
-        path), in both modes — host_eval only changes what gets read
-        back, never what the device computes."""
-        if self._sharded_fns is not None:
-            return self._sharded_fns[0](self._states, words)
-        return _group_step_compact(self._states, words, self._n,
-                                   self._delta_cap)
+        compiled per the group's :class:`~indy_plenum_tpu.tpu
+        .compile_plan.CompilePlan` (shard_map under a mesh, plain
+        vmapped jit otherwise). Returns (new_states, events, compact):
+        quorum eval AND the in-order frontier advance happen inside this
+        dispatch (the ordering fast path), in both modes — host_eval
+        only changes what gets read back, never what the device
+        computes."""
+        return self._plan.step(self._states, words)
 
-    def _dispatch_pending(self):
+    def _cell_votes(self, shard_votes: List[int], base: int, take) -> None:
+        """Attribute one member's scattered votes to occupancy-grid
+        cells: by member block alone (1-axis), or additionally by each
+        vote's SENDER block under the 2-axis fabric (the validator axis
+        shards the reduction, so a hot validator block is a real
+        hot-spot the governor must see)."""
+        if self._v_shards == 1:
+            shard_votes[base] += len(take)
+            return
+        for w in take:
+            shard_votes[base + min(((w >> 16) & 0x1FFF) // self._v_rows,
+                                   self._v_shards - 1)] += 1
+
+    def _dispatch_pending(self, interleave=None):
         """Chunk + scatter every member's pending votes (async dispatch);
         returns the list of chained (events, compact) step results, empty
-        if nothing was pending."""
+        if nothing was pending. ``interleave`` threads the pipelined
+        per-shard absorb generator through the scatter staging."""
         results = []
         while any(m._pending for m in self._members):
             chunks = []
             votes = 0
             shard_votes = [0] * self._n_shards
             for i, m in enumerate(self._members):
-                take, m._pending = (m._pending[:FLUSH_BATCH],
-                                    m._pending[FLUSH_BATCH:])
+                take, m._pending = (m._pending[:self.flush_batch],
+                                    m._pending[self.flush_batch:])
                 chunks.append(take)
                 votes += len(take)
-                shard_votes[i // self._shard_rows] += len(take)
+                self._cell_votes(
+                    shard_votes, (i // self._shard_rows) * self._v_shards,
+                    take)
             # the padded width rides the busiest member: a quiet tick
             # (a few straggler votes) scatters 16-wide, a full protocol
             # wave 128-wide — each rung is one cached XLA compilation.
@@ -1048,11 +1064,22 @@ class VotePlaneGroup:
                 shape = self._ladder.shape(busiest)
             else:
                 shape = ladder_shape(busiest)
-            with self.trace.span(
-                    "flush.dispatch",
-                    args={"votes": votes, "shape": shape}) \
+            if busiest > FLUSH_BATCH:
+                # scale-out rungs above the static ladder (n > 64): the
+                # containing pow2 up to the group's one-wave chunk limit
+                shape = FLUSH_BATCH
+                while shape < busiest:
+                    shape *= 2
+            args = None
+            if self.trace.enabled:
+                args = {"votes": votes, "shape": shape}
+                if self._n_shards > 1:
+                    # per-cell vote split: a hot shard is visible from a
+                    # trace dump alone (trace_tool.py --overlap)
+                    args["shard_votes"] = list(shard_votes)
+            with self.trace.span("flush.dispatch", args=args) \
                     if self.trace.enabled else _NO_SPAN:
-                words = self._stage_scatter(chunks, shape)
+                words = self._stage_scatter(chunks, shape, interleave)
                 self._states, events, compact = self._run_group_step(words)
             results.append((events, compact))
             self.flushes += 1
@@ -1066,26 +1093,38 @@ class VotePlaneGroup:
                 MetricsName.DEVICE_FLUSH_OCCUPANCY, votes / capacity)
         return results
 
+    def _cell_capacity(self, shape: int) -> List[float]:
+        """One dispatch's capacity per occupancy-grid cell. The capacity
+        denominator counts REAL member rows only — pad rows cannot hold
+        votes and must not dilute the governor's signal. Under the
+        2-axis fabric each member block's capacity is apportioned across
+        validator blocks by their share of real senders (sum over a
+        block's cells == the member block's capacity, so totals match
+        the 1-axis accounting); a block receiving more than its
+        proportional share of votes runs hot — exactly the signal the
+        hottest-cell governor law needs."""
+        if self._v_shards == 1:
+            return [r * shape for r in self._real_rows]
+        return [r * shape * v / self._n
+                for r in self._real_rows for v in self._v_real]
+
     def _account_shards(self, shard_votes: List[int], shape: int) -> None:
-        """Fold one dispatch into the per-shard occupancy series (the
-        capacity denominator counts REAL member rows only — pad rows
-        cannot hold votes and must not dilute the governor's signal)."""
+        """Fold one dispatch into the per-cell occupancy series."""
+        caps = self._cell_capacity(shape)
         for si in range(self._n_shards):
-            cap = self._real_rows[si] * shape
             self.flush_votes_per_shard[si] += shard_votes[si]
-            self.flush_capacity_per_shard[si] += cap
+            self.flush_capacity_per_shard[si] += caps[si]
         if self._n_shards > 1:
             self.metrics.add_event(
                 MetricsName.DEVICE_SHARD_COUNT, self._n_shards)
             for si in range(self._n_shards):
-                cap = self._real_rows[si] * shape
-                if cap:
+                if caps[si]:
                     self.metrics.add_event(
                         f"{MetricsName.DEVICE_SHARD_FLUSH_VOTES}.{si}",
                         shard_votes[si])
                     self.metrics.add_event(
                         f"{MetricsName.DEVICE_SHARD_FLUSH_CAPACITY}.{si}",
-                        cap)
+                        caps[si])
 
     def _dispatch_empty(self):
         """One padded no-vote step (cold start needs SOME events)."""
@@ -1109,14 +1148,33 @@ class VotePlaneGroup:
 
     def _flush_pipelined(self) -> None:
         # 1. absorb the steps dispatched LAST tick (usually complete by
-        # now: the whole tick's host work overlapped their round-trip)
-        self._sync_inflight()
+        # now: the whole tick's host work overlapped their round-trip).
+        # On a mesh with votes pending, the absorb runs PER MEMBER SHARD
+        # and interleaves with step 2's per-shard scatter staging: while
+        # shard i+1's fresh scatter buffer rides the link (device_put is
+        # async), shard i's readback block — already host-side thanks to
+        # last tick's copy_to_host_async — folds into the mirrors. The
+        # readback latency amortizes across the shard grid instead of
+        # summing in front of the dispatch.
+        absorb = None
+        if self._inflight is not None:
+            results, self._inflight = self._inflight, None
+            overlapped = self._flush_seq > self._inflight_seq
+            absorb = self._absorb_blocks(results, overlapped)
+            if self._mesh is None or self.host_eval \
+                    or not any(m._pending for m in self._members):
+                for _ in absorb:  # nothing to interleave with
+                    pass
+                absorb = None
         # 2. dispatch this tick's votes; results ride to the host next
         # tick. Kick the device->host copies off NOW: by the time next
         # tick's absorb runs, the bytes are already host-side and
         # device_get returns without a link round-trip — and on the fast
         # path those bytes are the compact deltas, not the event matrix.
-        results = self._dispatch_pending()
+        results = self._dispatch_pending(interleave=absorb)
+        if absorb is not None:
+            for _ in absorb:  # drain any blocks staging didn't cover
+                pass
         if results:
             for events, compact in results:
                 for arr in self._readback_arrays(events, compact):
@@ -1166,15 +1224,13 @@ class VotePlaneGroup:
         self._sync_inflight()
         deltas = np.zeros(self._m_pad, np.int32)
         deltas[member_idx] = delta
-        if self._sharded_fns is not None:
-            darr = jax.device_put(jnp.array(deltas), self._sharding(1))
-            self._states = self._sharded_fns[1](self._states, darr)
-        else:
-            self._states = _group_slide(self._states, jnp.asarray(deltas))
+        # the plan's slide carries its own in_shardings (pjit on a mesh),
+        # so the raw host array places correctly without an explicit put
+        self._states = self._plan.slide(self._states, deltas)
         self.version += 1
         self._host_prepared = None
         # device-eval mirrors roll with the member's window (the device
-        # applied the identical roll/clamp in _slide_core — prepared_acked
+        # applied the identical roll/clamp in q.slide_state — prepared_acked
         # rolled too, so surviving certs are NOT re-reported and the
         # mirror must keep them)
         mi, s = member_idx, self._log_size
@@ -1196,16 +1252,11 @@ class VotePlaneGroup:
         # pending for this member was cleared by the caller; other members'
         # buffered votes are untouched (flushed on their next query)
         self._sync_inflight()  # old-view events must not land post-reset
-        if self._sharded_fns is not None:
-            # shard_map zero rides a member MASK: a dynamic row index
-            # cannot address a shard-local block, a mask shards trivially
-            mask = np.zeros(self._m_pad, np.uint8)
-            mask[member_idx] = 1
-            marr = jax.device_put(jnp.array(mask), self._sharding(1))
-            self._states = self._sharded_fns[2](self._states, marr)
-        else:
-            self._states = _group_zero_member(
-                self._states, jnp.int32(member_idx))
+        # the zero rides a member MASK on every plan: a dynamic row index
+        # cannot address a shard-local block, a mask shards trivially
+        mask = np.zeros(self._m_pad, np.uint8)
+        mask[member_idx] = 1
+        self._states = self._plan.zero(self._states, mask)
         self.version += 1
         self._host_prepared = None
         # the member's device plane is all-zero now; its mirrors must be

@@ -16,6 +16,27 @@ running there.
 import os
 
 
+def parse_mesh_shape(spec) -> tuple:
+    """Parse a ``--mesh`` CLI value into a fabric mesh shape: ``"8"`` ->
+    ``(8,)`` (member-sharded), ``"4x2"`` -> ``(4, 2)`` (the member x
+    validator 2-axis fabric). Import-light (no jax) so the pre-argparse
+    device-provisioning sniff can use it too. Raises ValueError on
+    anything else."""
+    dims = tuple(int(p) for p in str(spec).lower().split("x"))
+    if not 1 <= len(dims) <= 2 or any(d < 1 for d in dims):
+        raise ValueError(f"mesh shape must be M or MxV with dims >= 1: "
+                         f"{spec!r}")
+    return dims
+
+
+def mesh_devices(shape) -> int:
+    """Device count a fabric mesh shape needs (what to provision)."""
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
 def ensure_host_platform_devices(n: int) -> None:
     """Append the host-device-count flag if no such flag is present yet
     (a preset flag — e.g. from tests/conftest.py or the operator — wins)."""

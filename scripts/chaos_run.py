@@ -52,11 +52,12 @@ def main() -> int:
                              "(requires --tick; the report's "
                              "governor.tick_interval metrics record the "
                              "deterministic interval trajectory)")
-    parser.add_argument("--mesh", type=int, default=0,
-                        help="shard the grouped vote plane over this many "
-                             "devices (requires --device-quorum; on CPU "
-                             "the host platform self-provisions virtual "
-                             "devices)")
+    parser.add_argument("--mesh", default="0",
+                        help="shard the grouped vote plane: M devices on "
+                             "the member axis (e.g. 4) or an MxV member "
+                             "x validator 2-axis fabric (e.g. 2x2); "
+                             "requires --device-quorum; on CPU the host "
+                             "platform self-provisions virtual devices")
     parser.add_argument("--trace", action="store_true",
                         help="arm the consensus flight recorder: the "
                              "report gains trace_hash + flight_recorder "
@@ -70,26 +71,35 @@ def main() -> int:
         parser.error("--tick requires --device-quorum")
     if args.adaptive_tick and args.tick <= 0:
         parser.error("--adaptive-tick requires --tick")
-    if args.mesh > 0 and not args.device_quorum:
-        parser.error("--mesh requires --device-quorum")
+    mesh_shape = None
+    if args.mesh not in ("0", 0):
+        from indy_plenum_tpu.utils.jax_env import parse_mesh_shape
+
+        try:
+            mesh_shape = parse_mesh_shape(args.mesh)
+        except ValueError as exc:
+            parser.error(str(exc))
+        if not args.device_quorum:
+            parser.error("--mesh requires --device-quorum")
 
     mesh = None
-    if args.mesh > 0:
+    if mesh_shape is not None:
         # XLA fixes the device topology at backend init; the flag must
         # land before the first device query
         from indy_plenum_tpu.utils.jax_env import (
             ensure_host_platform_devices,
+            mesh_devices,
         )
 
-        ensure_host_platform_devices(args.mesh)
-        import numpy as np
-        from jax.sharding import Mesh
+        n_dev = mesh_devices(mesh_shape)
+        ensure_host_platform_devices(n_dev)
+        from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
 
         devices = jax.devices()
-        if len(devices) < args.mesh:
-            parser.error(f"need {args.mesh} devices, have {len(devices)} "
+        if len(devices) < n_dev:
+            parser.error(f"need {n_dev} devices, have {len(devices)} "
                          "(XLA_FLAGS was set too late or preset smaller)")
-        mesh = Mesh(np.array(devices[:args.mesh]), ("members",))
+        mesh = make_fabric_mesh(devices, mesh_shape)
 
     if args.list:
         for name in sorted(SCENARIOS):

@@ -45,6 +45,15 @@ ordered/sim-second under saturation collapses more than
 ``--ingress-tolerance`` below the unsaturated run (admission exists to
 protect goodput, not to trade it away).
 
+Fabric gate (PR 9): unless ``--no-fabric-gate``, the script runs the
+n=16/k=6 workload on the 2-axis member x validator fabric (half the
+sharded gate's devices on each axis) and compares it against the 1-axis
+mesh run on the SAME seed — ``ordered_hash`` must match bit-for-bit and
+dispatches/ordered-batch + bytes/readback must sit within
+``--fabric-tolerance`` (the psum quorum reduction and per-shard
+pipelined readbacks may move work between chips, never change or
+inflate it).
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
@@ -59,12 +68,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-# the sharded gate needs a multi-device host platform, and XLA fixes the
-# device topology at backend init — so the flag must be in the
-# environment before jax initializes. Provision ONLY when that gate will
-# actually run: the 1-device budgets and governor gates are calibrated
-# on the unmodified topology and must keep measuring there.
-if "--no-sharded-gate" not in sys.argv:
+# the sharded/fabric gates need a multi-device host platform, and XLA
+# fixes the device topology at backend init — so the flag must be in the
+# environment before jax initializes. Provision ONLY when one of those
+# gates will actually run: the 1-device budgets and governor gates are
+# calibrated on the unmodified topology and must keep measuring there.
+if ("--no-sharded-gate" not in sys.argv
+        or "--no-fabric-gate" not in sys.argv):
     from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
 
     _width = 4
@@ -181,6 +191,7 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     }
     if mesh is not None:
         result["shards"] = pool.vote_group.shards
+        result["mesh_shape"] = list(pool.vote_group.mesh_shape)
         result["shard_occupancy"] = pool.vote_group.shard_occupancy
     if pool.governor is not None:
         result["governor"] = pool.governor.trajectory_summary()
@@ -232,8 +243,7 @@ def sharded_gates(args) -> "tuple[dict, list]":
     acceptance shape (n=16, k=6, 4-way host mesh by default); returns
     (record, failures). The digests must be bit-identical and the
     dispatch discipline must survive sharding."""
-    import numpy as np
-    from jax.sharding import Mesh
+    from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
 
     devices = jax.devices()
     if len(devices) < args.mesh_devices:
@@ -241,7 +251,7 @@ def sharded_gates(args) -> "tuple[dict, list]":
                             f"have {len(devices)}"},
                 [f"sharded gate needs {args.mesh_devices} host devices "
                  f"(have {len(devices)}; XLA_FLAGS set too late?)"])
-    mesh = Mesh(np.array(devices[:args.mesh_devices]), ("members",))
+    mesh = make_fabric_mesh(devices, (args.mesh_devices,))
     single = measure(args.sharded_nodes, args.sharded_instances,
                      args.batches, args.batch_size, args.tick,
                      seed=args.seed)
@@ -269,6 +279,68 @@ def sharded_gates(args) -> "tuple[dict, list]":
         "sharded_tolerance": tol,
         "digests_match": sharded["ordered_hash"] == single["ordered_hash"],
         "sharded_dispatch_ratio": round(m_pb / s_pb, 3) if s_pb else None,
+    }
+    return record, failures
+
+
+def fabric_gate(args, base: "dict | None" = None) -> "tuple[dict, list]":
+    """Scale-out quorum fabric gate: the SAME n=16/k=6 workload and seed
+    on a 1-axis member mesh vs the 2-axis member x validator fabric
+    (both over the sharded gate's device pool). The fabric is a
+    PLACEMENT choice: ``ordered_hash`` must match bit-for-bit,
+    dispatches/ordered-batch and readback bytes must sit within
+    ``--fabric-tolerance`` — the psum quorum reduction and per-shard
+    pipelined readbacks may move work, never change or inflate it.
+    ``base`` reuses the sharded gate's mesh run (identical arguments)
+    as the 1-axis arm instead of re-paying the cold simulation."""
+    from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
+
+    devices = jax.devices()
+    if len(devices) < args.mesh_devices:
+        return ({"skipped": f"need {args.mesh_devices} devices, "
+                            f"have {len(devices)}"},
+                [f"fabric gate needs {args.mesh_devices} host devices "
+                 f"(have {len(devices)}; XLA_FLAGS set too late?)"])
+    if base is None:
+        base = measure(args.sharded_nodes, args.sharded_instances,
+                       args.batches, args.batch_size, args.tick,
+                       seed=args.seed,
+                       mesh=make_fabric_mesh(devices,
+                                             (args.mesh_devices,)))
+    # the 2-axis grid over the same device pool: members x validators
+    m_axis = max(args.mesh_devices // 2, 1)
+    two = measure(args.sharded_nodes, args.sharded_instances,
+                  args.batches, args.batch_size, args.tick,
+                  seed=args.seed,
+                  mesh=make_fabric_mesh(devices, (m_axis, 2)))
+    tol = args.fabric_tolerance
+    failures = []
+    if two["ordered_hash"] != base["ordered_hash"]:
+        failures.append("2-axis fabric ordered digests diverge from the "
+                        "1-axis mesh run (the validator axis changed "
+                        "semantics)")
+    b_pb = base["device_dispatches_per_ordered_batch"]
+    t_pb = two["device_dispatches_per_ordered_batch"]
+    if b_pb and abs(t_pb - b_pb) > b_pb * tol:
+        failures.append(f"2-axis dispatches/batch {t_pb} drifts from "
+                        f"1-axis {b_pb} beyond {tol:.0%}")
+    # TOTAL readback bytes, not bytes/readback: per-shard absorbs split
+    # the same bytes across as many readbacks as the mesh has member
+    # shards, so the per-readback figure legitimately differs between
+    # mesh shapes — what must NOT drift is what crossed the link
+    b_rb, t_rb = base["readback_bytes"], two["readback_bytes"]
+    if b_rb and abs(t_rb - b_rb) > b_rb * tol:
+        failures.append(f"2-axis readback bytes {t_rb} drift from "
+                        f"1-axis {b_rb} beyond {tol:.0%} (the compact "
+                        "blocks should be identical; the validator axis "
+                        "must not be fetched twice)")
+    record = {
+        "one_axis": base,
+        "two_axis": two,
+        "fabric_tolerance": tol,
+        "digests_match": two["ordered_hash"] == base["ordered_hash"],
+        "fabric_dispatch_ratio": round(t_pb / b_pb, 3) if b_pb else None,
+        "fabric_readback_ratio": round(t_rb / b_rb, 3) if b_rb else None,
     }
     return record, failures
 
@@ -518,6 +590,13 @@ def main() -> int:
     ap.add_argument("--no-readback-gate", action="store_true",
                     help="skip the device-eval vs host-eval ordering "
                          "fast path comparison")
+    ap.add_argument("--no-fabric-gate", action="store_true",
+                    help="skip the 1-axis vs 2-axis quorum-fabric "
+                         "comparison")
+    ap.add_argument("--fabric-tolerance", type=float, default=0.10,
+                    help="max fractional dispatches/ordered-batch and "
+                         "bytes/readback drift the 2-axis fabric run "
+                         "may show vs the 1-axis mesh run")
     ap.add_argument("--readback-budget", type=float, default=32768,
                     help="max device->host bytes per readback the "
                          "compact (device-eval) run may average")
@@ -581,12 +660,19 @@ def main() -> int:
         result["governor_gate"] = record
         over.extend(failures)
     sharded_single = None
+    sharded_mesh = None
     if not args.no_sharded_gate:
         record, failures = sharded_gates(args)
         result["sharded_gate"] = record
         over.extend(failures)
         # same args as the tracing gate's untraced baseline — reuse it
         sharded_single = record.get("single_device")
+        # ... and as the fabric gate's 1-axis arm
+        sharded_mesh = record.get("mesh_sharded")
+    if not args.no_fabric_gate:
+        record, failures = fabric_gate(args, base=sharded_mesh)
+        result["fabric_gate"] = record
+        over.extend(failures)
     if not args.no_trace_gate:
         record, failures = tracing_gate(args, base=sharded_single)
         result["tracing_gate"] = record

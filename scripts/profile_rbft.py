@@ -8,9 +8,10 @@ goes to stderr): the top-20 cumulative hotspots plus the dispatch-plane
 amortization numbers — ``device_dispatches_per_ordered_batch`` for the
 tick-batched run and, unless ``--no-baseline``, the same measured on a
 short per-message run (``QuorumTickInterval=0``) with the resulting
-``amortization_factor``. ``--mesh N`` shards the grouped vote plane over
-N host devices (mesh-sharded dispatch plane); the record then carries
-``shards`` and per-shard occupancy. ``--trace`` arms the consensus
+``amortization_factor``. ``--mesh M`` shards the grouped vote plane over
+M host devices (mesh-sharded dispatch plane) and ``--mesh MxV`` runs the
+member x validator 2-axis quorum fabric; the record then carries
+``shards``, ``mesh_shape`` and per-shard occupancy. ``--trace`` arms the consensus
 flight recorder: the span trace dumps to ``--trace-out`` (JSONL for
 ``scripts/trace_tool.py``) and the ``--json`` record gains
 ``phase_latency`` percentiles + ``critical_path``. The determinism cross-check
@@ -34,13 +35,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # profile's amortization baselines were measured on the unmodified
 # topology and must keep measuring there.
 if "--mesh" in sys.argv:
-    from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
+    from indy_plenum_tpu.utils.jax_env import (
+        ensure_host_platform_devices,
+        mesh_devices,
+        parse_mesh_shape,
+    )
 
     try:
-        _width = int(sys.argv[sys.argv.index("--mesh") + 1])
-    except (IndexError, ValueError):
-        _width = 8  # argparse will reject the malformed value below
-    ensure_host_platform_devices(max(_width, 1))
+        _raw = sys.argv[sys.argv.index("--mesh") + 1]
+    except IndexError:
+        _raw = "0"  # argparse rejects the missing value below
+    # "0" is the explicit unsharded sentinel: provision NOTHING (the
+    # amortization baselines must keep measuring on the unmodified
+    # topology); a malformed value provisions nothing either — main()
+    # rejects it with a proper parser error
+    if _raw != "0":
+        try:
+            _width = mesh_devices(parse_mesh_shape(_raw))
+        except ValueError:
+            _width = 0
+        if _width:
+            ensure_host_platform_devices(_width)
 
 import jax  # noqa: E402
 
@@ -159,9 +174,11 @@ def main():
     ap.add_argument("--static-tick", action="store_true",
                     help="freeze the tick at 0.1 (skip the adaptive "
                          "governor the profiled loop now runs by default)")
-    ap.add_argument("--mesh", type=int, default=0,
-                    help="shard the grouped vote plane over this many "
-                         "host devices (0 = unsharded)")
+    ap.add_argument("--mesh", default="0",
+                    help="shard the grouped vote plane: M host devices "
+                         "on the member axis (e.g. 8) or an MxV member "
+                         "x validator 2-axis fabric (e.g. 4x2); 0 = "
+                         "unsharded")
     ap.add_argument("--ingress-capacity", type=int, default=0,
                     help="bound the auth queue (admission control): the "
                          "profiled pool then runs the SIGNED ingress "
@@ -179,14 +196,21 @@ def main():
     n, k, txns = args.n_nodes, args.instances, args.txns
 
     mesh = None
-    if args.mesh > 0:
-        import numpy as np
-        from jax.sharding import Mesh
+    if args.mesh not in ("0", 0):
+        from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
+        from indy_plenum_tpu.utils.jax_env import (
+            mesh_devices,
+            parse_mesh_shape,
+        )
 
+        try:
+            shape = parse_mesh_shape(args.mesh)
+        except ValueError as exc:
+            ap.error(str(exc))
         devices = jax.devices()
-        assert len(devices) >= args.mesh, (
-            f"need {args.mesh} devices, have {len(devices)}")
-        mesh = Mesh(np.array(devices[:args.mesh]), ("members",))
+        assert len(devices) >= mesh_devices(shape), (
+            f"need {mesh_devices(shape)} devices, have {len(devices)}")
+        mesh = make_fabric_mesh(devices, shape)
 
     pool = _build_pool(n, k, tick_interval=0.1,
                        adaptive=not args.static_tick, mesh=mesh,
@@ -245,8 +269,11 @@ def main():
         "device_dispatches_per_ordered_batch": round(per_batch, 2),
         "flush_occupancy_avg": round(occ.avg, 4) if occ else None,
         # mesh-sharded dispatch plane: mesh width + each shard's
-        # cumulative occupancy (scattered votes / real-row capacity)
+        # cumulative occupancy (scattered votes / real-row capacity);
+        # mesh_shape distinguishes (M,) member sharding from the (M, V)
+        # 2-axis quorum fabric behind the same flat shards count
         "shards": pool.vote_group.shards,
+        "mesh_shape": list(pool.vote_group.mesh_shape),
         "shard_occupancy": pool.vote_group.shard_occupancy,
         "effective_tick_interval": (tick_stat.last if tick_stat
                                     else pool.config.QuorumTickInterval),

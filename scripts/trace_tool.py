@@ -132,6 +132,19 @@ def main() -> int:
                 print(f"  {t.get('ts', 0):>14.6f} {t['dispatches']:>10d} "
                       f"{t['votes']:>7d} {t['readbacks']:>9d} "
                       f"{t['overlapped']:>10d} {t['readback_bytes']:>9d}")
+        if "per_shard" in ov:
+            ps = ov["per_shard"]
+            print("per-shard (scale-out quorum fabric; a hot shard is "
+                  "visible here alone):")
+            print(f"  {'member_shard':>12s} {'readbacks':>9s} "
+                  f"{'rb_bytes':>9s}")
+            for s, (rb, b) in enumerate(zip(ps["readbacks"],
+                                            ps["readback_bytes"])):
+                print(f"  {s:>12d} {rb:>9d} {b:>9d}")
+            print(f"  {'grid_cell':>12s} {'votes':>9s} {'share':>9s}")
+            for c, (v, sh) in enumerate(zip(ps["votes"],
+                                            ps["vote_share"])):
+                print(f"  {c:>12d} {v:>9d} {sh:>9.2%}")
     if record.get("flight_events"):
         print("flight events:")
         for ev in record["flight_events"]:

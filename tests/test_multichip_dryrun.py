@@ -107,3 +107,35 @@ def test_sharded_vote_group_state_is_split_across_mesh(eight_devices):
     # one member per device: the addressable shard is (1, 8, 8)
     shard = votes.addressable_shards[0]
     assert shard.data.shape[0] == votes.shape[0] // 8
+
+
+def test_two_axis_vote_group_state_is_split_across_grid(eight_devices):
+    """Placement proof for the 2-axis quorum fabric: each chip holds its
+    (member block, validator block) tile of the vote matrices — and the
+    per-shard counters cover the full grid."""
+    import jax
+
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.simulation.quorum_driver import make_vote_group
+    from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
+
+    mesh = make_fabric_mesh(jax.devices(), (4, 2))
+    cfg = getConfig({"LOG_SIZE": 8, "CHK_FREQ": 4})
+    group = make_vote_group(8, [f"n{i}" for i in range(8)], cfg, mesh=mesh)
+    group.view(0).record_preprepare(1)
+    for sender in (f"n{i}" for i in range(8)):
+        group.view(0).record_prepare(sender, 1)
+    group.flush()
+    group._sync_inflight()  # pipelined default: absorb before asserting
+    votes = group._states.prepare_votes  # (8, 8, 8)
+    assert len(votes.sharding.device_set) == 8
+    tile = votes.addressable_shards[0].data
+    assert tile.shape == (votes.shape[0] // 4, votes.shape[1] // 2, 8)
+    # quorum counts psum over the validator axis: all 8 senders counted
+    assert group.view(0).prepare_count(1) == 8
+    assert group.mesh_shape == (4, 2)
+    assert len(group.flush_votes_per_shard) == 8
+    assert sum(group.flush_votes_per_shard) == group.flush_votes_total
+    # the per-shard pipelined readback attributed every byte
+    assert sum(group.readback_bytes_per_shard) \
+        == group.readback_bytes_total > 0

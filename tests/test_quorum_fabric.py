@@ -1,0 +1,390 @@
+"""Scale-out quorum fabric (PR 9): member x validator 2-axis mesh.
+
+Contract under test (README "Scale-out quorum fabric"): the 2-axis mesh
+shards the member axis AND each plane's validator axis (quorum counts
+reduce with psum over the validator axis), both axes pad to mesh
+multiples, readbacks run per member shard pipelined against the next
+shard's scatter staging, and the whole thing is a PLACEMENT choice —
+bit-identical ordered digests to the 1-device and 1-axis runs on the
+same seed, through view changes and under chaos. The compilation-helper
+layer (tpu.compile_plan) picks jit / pjit-with-shardings / shard_map per
+step function from the mesh shape.
+
+The n=256 acceptance shape rides the slow lane; ``bench.py fabric`` and
+``check_dispatch_budget.py``'s fabric gate cover the throughput/CI
+comparisons.
+"""
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+from indy_plenum_tpu.tpu import quorum as q  # noqa: E402
+
+
+def _run_pool(n_nodes, k, seed, mesh, view_change=True, txns=6):
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                     "QuorumTickInterval": 0.05,
+                     "QuorumTickAdaptive": True})
+    pool = SimPool(n_nodes, seed=seed, config=cfg, device_quorum=True,
+                   shadow_check=False, num_instances=k, mesh=mesh)
+    primary = pool.nodes[0].data.primaries[0]
+    for i in range(txns):
+        pool.submit_request(i)
+    pool.run_for(8)
+    if view_change:
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 104):
+            pool.submit_request(i)
+        pool.run_for(12)
+    assert pool.honest_nodes_agree()
+    return pool
+
+
+# ---------------------------------------------------------------------
+# tier-1: mesh builder + shape parsing
+# ---------------------------------------------------------------------
+
+def test_parse_mesh_shape():
+    from indy_plenum_tpu.utils.jax_env import mesh_devices, parse_mesh_shape
+
+    assert parse_mesh_shape("8") == (8,)
+    assert parse_mesh_shape("4x2") == (4, 2)
+    assert parse_mesh_shape("4X2") == (4, 2)
+    assert mesh_devices((4, 2)) == 8
+    for bad in ("0", "4x0", "2x2x2", "x", "fast"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+
+
+def test_fabric_mesh_builder(eight_devices):
+    mesh1 = q.make_fabric_mesh(eight_devices, (4,))
+    assert mesh1.axis_names == ("members",)
+    mesh2 = q.make_fabric_mesh(eight_devices, (4, 2))
+    assert mesh2.axis_names == ("members", "validators")
+    assert mesh2.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        q.make_fabric_mesh(eight_devices, (4, 3))  # needs 12 devices
+    with pytest.raises(ValueError):
+        q.make_fabric_mesh(eight_devices, (2, 2, 2))
+
+
+def test_compile_plan_strategies(eight_devices):
+    """The Titanax pattern: strategy per function, resolved from the
+    mesh shape in ONE place — jit unsharded, shard_map for the (hot,
+    collective-bearing) step, pjit-with-shardings for slide/zero."""
+    from indy_plenum_tpu.tpu import vote_plane
+    from indy_plenum_tpu.tpu.compile_plan import plan_for
+
+    flat = plan_for(None, 4, 4, 16)
+    assert flat.strategy == {"step": "jit", "slide": "jit", "zero": "jit"}
+    assert flat.mesh_shape == ()
+    one = plan_for(q.make_fabric_mesh(eight_devices, (4,)), 4, 4, 16)
+    assert one.strategy == {"step": "shard_map", "slide": "pjit",
+                            "zero": "pjit"}
+    assert one.mesh_shape == (4,)
+    two = plan_for(q.make_fabric_mesh(eight_devices, (2, 2)), 4, 4, 16)
+    assert two.strategy["step"] == "shard_map"
+    assert two.mesh_shape == (2, 2)
+    # resolved once per key (lru): the group's hot path never rebuilds
+    assert plan_for(None, 4, 4, 16) is flat
+    # the hand-built shard_map triple is gone for good
+    assert not hasattr(vote_plane, "_sharded_group_fns")
+
+
+# ---------------------------------------------------------------------
+# tier-1: 2-axis semantics + padding + accounting
+# ---------------------------------------------------------------------
+
+@pytest.mark.perf
+def test_two_axis_digest_identity_incl_view_change(eight_devices):
+    """(2, 2) member x validator fabric vs 1-device on the same seed,
+    adaptive tick, through a view change: bit-identical ordered digests
+    (the n=256 acceptance shape runs in the slow lane)."""
+    fabric = _run_pool(8, 2, seed=37,
+                       mesh=q.make_fabric_mesh(eight_devices, (2, 2)))
+    single = _run_pool(8, 2, seed=37, mesh=None)
+    assert fabric.ordered_hash() == single.ordered_hash()
+    group = fabric.vote_group
+    assert group.mesh_shape == (2, 2)
+    assert group.shards == 4
+    # the vote matrices really live split across BOTH axes
+    states = group._states.prepare_votes
+    assert len(states.sharding.device_set) == 4
+    shard = states.addressable_shards[0]
+    assert shard.data.shape[0] == states.shape[0] // 2  # member blocks
+    assert shard.data.shape[1] == states.shape[1] // 2  # validator blocks
+
+
+def test_validator_axis_pads_to_mesh_multiple(eight_devices):
+    """N not divisible by the validator mesh axis is padded, not
+    rejected: pad validator rows never receive votes, quorum counts and
+    thresholds see only the real senders."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(5)]  # 5 rows on a 2-way axis
+    group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                           mesh=q.make_fabric_mesh(eight_devices, (2, 2)))
+    assert group._n_pad == 6 and group._v_rows == 3
+    assert group._v_real == [3, 2]
+    group.view(0).record_preprepare(1)
+    for sender in validators[1:]:
+        group.view(0).record_prepare(sender, 1)
+    group.flush()
+    assert group.view(0).prepare_count(1) == 4
+    assert group.view(0).has_prepare_quorum(1)  # n=5, f=1: needs 3
+    # grid cells: 2 member blocks x 2 validator blocks
+    assert len(group.flush_votes_per_shard) == 4
+    assert sum(group.flush_votes_per_shard) == group.flush_votes_total == 5
+    assert sum(group.flush_capacity_per_shard) == pytest.approx(
+        group.flush_capacity_total)
+
+
+def test_grid_occupancy_attributes_votes_by_sender_block(eight_devices):
+    """2-axis cells split votes by SENDER block: a hot validator block
+    (all votes from the first half of the validators) must light up its
+    column of cells, not dilute across the grid."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+    group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                           mesh=q.make_fabric_mesh(eight_devices, (2, 2)))
+    # members 0 and 2 (one per member block) hear only from n0/n1 —
+    # validator block 0
+    for m in (0, 2):
+        for sender in ("n0", "n1"):
+            group.view(m).record_prepare(sender, 1)
+    group.flush()
+    votes = group.flush_votes_per_shard
+    assert votes == [2, 0, 2, 0]  # cells (0,0), (0,1), (1,0), (1,1)
+    occ = group.shard_occupancy
+    assert occ[0] > 0 and occ[1] == 0.0
+    # per-cell capacity is the member block's share apportioned by real
+    # validator rows; cell sums must reproduce the 1-axis totals
+    assert sum(group.flush_capacity_per_shard) == pytest.approx(
+        group.flush_capacity_total)
+
+
+def test_two_axis_slide_and_reset_match_unsharded(eight_devices):
+    """Window slide and view-change reset through the pjit plan leave
+    the same events as the 1-device path — on BOTH mesh layouts."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+
+    def run(mesh):
+        group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                               mesh=mesh)
+        for m in range(4):
+            group.view(m).record_preprepare(2)
+            for sender in validators:
+                group.view(m).record_prepare(sender, 2)
+                group.view(m).record_commit(sender, 2)
+        group.flush()
+        group.view(1).slide_to(1)
+        group.view(2).reset()
+        group.flush()
+        return [np.asarray(group._host_prepared)[m].tolist()
+                for m in range(4)]
+
+    expect = run(None)
+    assert run(q.make_fabric_mesh(eight_devices, (2, 2))) == expect
+    assert run(q.make_fabric_mesh(eight_devices, (4, 2))) == expect
+
+
+def test_per_shard_pipelined_readback(eight_devices):
+    """Mesh absorbs run per member shard: every byte lands in the
+    per-shard series, each block is its own flush.readback span with a
+    ``shard`` arg, and dispatch spans carry the per-cell vote split."""
+    from indy_plenum_tpu.observability.trace import TraceRecorder
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+    group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                           mesh=q.make_fabric_mesh(eight_devices, (2, 2)),
+                           pipelined=True)
+    clock = [0.0]
+    group.trace = TraceRecorder(lambda: clock[0])
+    for tick in range(3):
+        for m in range(4):
+            group.view(m).record_preprepare(tick + 1)
+            for sender in validators:
+                group.view(m).record_prepare(sender, tick + 1)
+        group.flush()
+        clock[0] += 1.0
+    group._sync_inflight()
+    assert group.readback_bytes_total > 0
+    assert sum(group.readback_bytes_per_shard) == group.readback_bytes_total
+    assert all(b > 0 for b in group.readback_bytes_per_shard)
+    # pipelined: later flushes absorbed steps dispatched earlier
+    assert group.readbacks_overlapped > 0
+    events = group.trace.events()
+    rb = [ev for ev in events if ev["name"] == "flush.readback"]
+    assert rb and all("shard" in ev["args"] for ev in rb)
+    assert sum(ev["args"]["bytes"] for ev in rb) \
+        == group.readback_bytes_total
+    assert {ev["args"]["shard"] for ev in rb} == {0, 1}
+    disp = [ev for ev in events if ev["name"] == "flush.dispatch"]
+    assert disp and all(len(ev["args"]["shard_votes"]) == 4
+                        for ev in disp)
+
+
+def test_overlap_report_per_shard_columns():
+    """trace_tool's --overlap view surfaces the per-shard columns (no
+    jax needed — synthetic dispatch events)."""
+    from indy_plenum_tpu.observability.trace import overlap_report
+
+    events = [
+        {"name": "flush.dispatch", "cat": "dispatch", "ts": 0.0,
+         "args": {"votes": 6, "shape": 16, "shard_votes": [4, 0, 2, 0]}},
+        {"name": "flush.readback", "cat": "dispatch", "ts": 0.1,
+         "args": {"bytes": 100, "overlapped": True, "shard": 0}},
+        {"name": "flush.readback", "cat": "dispatch", "ts": 0.2,
+         "args": {"bytes": 60, "overlapped": True, "shard": 1}},
+        {"name": "tick.flush", "cat": "dispatch", "ts": 0.3, "args": {}},
+    ]
+    report = overlap_report(events)
+    assert report["ticks"] == 1 and report["readbacks"] == 2
+    ps = report["per_shard"]
+    assert ps["readback_bytes"] == [100, 60]
+    assert ps["readbacks"] == [1, 1]
+    assert ps["votes"] == [4, 0, 2, 0]
+    assert ps["vote_share"] == [round(4 / 6, 4), 0.0, round(2 / 6, 4), 0.0]
+    # unsharded dumps keep the old shape: no per_shard block at all
+    flat = [
+        {"name": "flush.dispatch", "cat": "dispatch", "ts": 0.0,
+         "args": {"votes": 6, "shape": 16}},
+        {"name": "flush.readback", "cat": "dispatch", "ts": 0.1,
+         "args": {"bytes": 100, "overlapped": True}},
+        {"name": "tick.flush", "cat": "dispatch", "ts": 0.3, "args": {}},
+    ]
+    assert "per_shard" not in overlap_report(flat)
+
+
+# ---------------------------------------------------------------------
+# tier-1: ring-collective vote exchange (reference path + guard)
+# ---------------------------------------------------------------------
+
+def test_ring_shift_reference_rotates_member_blocks(eight_devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from indy_plenum_tpu.tpu import ring_exchange as rx
+
+    mesh = q.make_fabric_mesh(eight_devices, (4,))
+    x = np.arange(8 * 3, dtype=np.int32).reshape(8, 3)
+    xs = jax.device_put(x, NamedSharding(mesh, P("members", None)))
+    out = np.asarray(rx.ring_shift_reference(xs, mesh, shift=1))
+    assert (out == np.roll(x.reshape(4, 2, 3), 1, axis=0)
+            .reshape(8, 3)).all()
+    # full-circle shift is the identity (and short-circuits)
+    same = rx.ring_shift_planes(xs, mesh, shift=4)
+    assert same is xs
+
+
+def test_ring_shift_planes_moves_vote_state(eight_devices):
+    """Whole VoteState stacks migrate between member shards — the
+    device-to-device path vote-plane rebalancing will ride."""
+    import jax.numpy as jnp
+
+    from indy_plenum_tpu.tpu import ring_exchange as rx
+
+    mesh = q.make_fabric_mesh(eight_devices, (2, 2))
+    proto = q.init_state(4, 8, 2)
+    states = jax.tree.map(lambda a: jnp.stack([a] * 4), proto)
+    states = states._replace(frontier=jnp.arange(4, dtype=jnp.int32))
+    shifted = rx.ring_shift_planes(states, mesh, shift=1)
+    assert np.asarray(shifted.frontier).tolist() == [2, 3, 0, 1]
+
+
+def test_ring_shift_pallas_guarded_off_tpu(eight_devices):
+    """The pallas RDMA path must refuse to build anywhere but a real
+    TPU backend (the kernel is a template for hardware runs, never a
+    silent CPU fallback)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from indy_plenum_tpu.tpu import ring_exchange as rx
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("guard only exists off-TPU")
+    mesh = q.make_fabric_mesh(eight_devices, (4,))
+    x = jax.device_put(np.zeros((8, 128), np.float32),
+                       NamedSharding(mesh, P("members", None)))
+    with pytest.raises(NotImplementedError):
+        rx.ring_shift_pallas(x, mesh)
+    # the planes entry point falls back to the reference path instead
+    out = rx.ring_shift_planes(x, mesh, shift=1)
+    assert out.shape == x.shape
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="pallas ring RDMA needs real TPU hardware")
+def test_ring_shift_pallas_matches_reference(eight_devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from indy_plenum_tpu.tpu import ring_exchange as rx
+
+    mesh = q.make_fabric_mesh(eight_devices, (4,))
+    x = np.arange(8 * 128, dtype=np.float32).reshape(8, 128)
+    xs = jax.device_put(x, NamedSharding(mesh, P("members", None)))
+    assert (np.asarray(rx.ring_shift_pallas(xs, mesh))
+            == np.asarray(rx.ring_shift_reference(xs, mesh, 1))).all()
+
+
+# ---------------------------------------------------------------------
+# slow lane: the n=256 acceptance shape + chaos on the 2-axis fabric
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.perf
+def test_two_axis_digest_identity_n256(eight_devices):
+    """The ISSUE 9 acceptance shape: n=256 on the (4, 2) member x
+    validator fabric vs 1-device, adaptive governor, through a view
+    change — bit-identical ordered digests."""
+    fabric = _run_pool(256, 1, seed=41, txns=3,
+                       mesh=q.make_fabric_mesh(eight_devices, (4, 2)))
+    single = _run_pool(256, 1, seed=41, txns=3, mesh=None)
+    assert fabric.ordered_hash() == single.ordered_hash()
+    group = fabric.vote_group
+    assert group.mesh_shape == (4, 2)
+    assert group._m_pad == 256 and group._n_pad == 256
+    assert sum(group.readback_bytes_per_shard) == group.readback_bytes_total
+    # >= 80% of readbacks overlapped a full tick of host work (the
+    # per-shard pipelined flush acceptance number)
+    assert group.readbacks_overlapped >= 0.8 * group.readbacks
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_f_crash_partition_on_two_axis_fabric(eight_devices):
+    """f crash + partition through the 2-axis fabric: all invariants
+    hold, ordered hashes equal the 1-device run on the same seed, and
+    the traced fabric run replays to a bit-identical trace_hash (the
+    chaos replay contract extends to the 2-axis placement)."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    mesh = q.make_fabric_mesh(eight_devices, (2, 2))
+    fabric = run_scenario("f_crash_partition", seed=7,
+                          device_quorum=True, quorum_tick_interval=0.05,
+                          quorum_tick_adaptive=True, mesh=mesh,
+                          trace=True)
+    assert fabric.verdict_as_expected, fabric.failed
+    assert not fabric.expected_failures
+    assert fabric.dispatch_mode["mesh"] == "2x2"
+    assert "--mesh 2x2" in fabric.replay_command
+    single = run_scenario("f_crash_partition", seed=7,
+                          device_quorum=True, quorum_tick_interval=0.05,
+                          quorum_tick_adaptive=True)
+    assert fabric.ordered_hash_per_node == single.ordered_hash_per_node
+    replay = run_scenario("f_crash_partition", seed=7,
+                          device_quorum=True, quorum_tick_interval=0.05,
+                          quorum_tick_adaptive=True, mesh=mesh,
+                          trace=True)
+    assert fabric.trace_hash == replay.trace_hash

@@ -67,6 +67,65 @@ def verify_proved_reply(reply: StateProofReply,
                                  now=now, max_age=max_age)
 
 
+def verify_proved_read(read,
+                       pool_bls_keys: Dict[str, str],
+                       min_participants: int,
+                       now: Optional[float] = None,
+                       max_age: Optional[float] = None) -> bool:
+    """Verify a :class:`~indy_plenum_tpu.ingress.read_service.ProofRead`
+    end-to-end with nothing but the pool's BLS keys (the state-proof
+    plane's client half — README "State-proof plane").
+
+    Three bindings, each independently forgeable only by breaking the
+    crypto: (1) the RFC 6962 audit path binds (index, leaf) to ``root``
+    at ``tree_size``; (2) the multi-signature's ``txn_root_hash`` binds
+    ``root`` to the value the pool co-signed at a stabilized checkpoint
+    window; (3) :func:`verify_pool_multi_sig` binds that value to
+    >= ``min_participants`` pool validators. A flipped root, flipped
+    signature, tampered participant set, or a proof replayed against a
+    different window's root all fail one of the three. ``now``/
+    ``max_age`` additionally reject STALE windows: a byzantine node
+    replaying a genuinely-signed old window (e.g. an absence proof for a
+    key written since) fails the freshness check even though every
+    binding above holds.
+
+    ``read`` needs ``leaf`` / ``index`` / ``path`` / ``tree_size`` /
+    ``root`` / ``multi_sig`` attributes (``multi_sig`` may be the wire
+    dict or a :class:`MultiSignature`).
+    """
+    ms = getattr(read, "multi_sig", None)
+    if ms is None:
+        return False
+    if not isinstance(ms, MultiSignature):
+        try:
+            ms = MultiSignature.from_dict(dict(ms))
+        except (KeyError, TypeError, ValueError):
+            return False
+    # 1. the audit path binds (index, leaf) to the root. The reply is
+    # UNTRUSTED input: malformed fields (str root, non-bytes path
+    # elements, ...) must be a False verdict, never an exception out of
+    # the client's read loop — TypeError covers the bytes-concat and
+    # hashing paths ValueError/IndexError do not
+    if not isinstance(read.root, (bytes, bytearray)):
+        return False
+    from ..ledger.merkle_verifier import STH, MerkleVerifier
+
+    try:
+        ok = MerkleVerifier().verify_leaf_inclusion(
+            read.leaf, read.index, read.path,
+            STH(read.tree_size, read.root))
+    except (ValueError, IndexError, TypeError):
+        return False
+    if not ok:
+        return False
+    # 2. the multi-sig's signed value names exactly this root
+    if ms.value.txn_root_hash != b58encode(read.root):
+        return False
+    # 3. the pool signed that value (+ optional freshness)
+    return verify_pool_multi_sig(ms, pool_bls_keys, min_participants,
+                                 now=now, max_age=max_age)
+
+
 def verify_pool_multi_sig(ms: MultiSignature,
                           pool_bls_keys: Dict[str, str],
                           min_participants: int,

@@ -34,6 +34,22 @@ class MetricsName:
     READ_BATCH_SIZE = "ingress.read_batch_size"
     READ_SERVED = "ingress.read_served"
     READ_QPS = "ingress.read_qps"
+    # read-path backpressure: the read queue's own bounded-queue law
+    # (same seeded drop-newest shed as writes) — pre-drain depth per
+    # drain and shed totals, segregated from the write-side series
+    READ_QUEUE_DEPTH = "ingress.read_queue_depth"
+    READ_SHED = "ingress.read_shed"
+    # state-proof plane (proofs/): windows captured per checkpoint
+    # stabilization, serve-path hit/miss accounting (hits are dict
+    # lookups — zero pairings, the proof gate's core assertion), reads
+    # served WITH a pool proof attached, and the pairing work the
+    # batched verifier actually performed
+    PROOF_WINDOWS_SIGNED = "proof.windows_signed"
+    PROOF_CACHE_HIT = "proof.cache_hit"
+    PROOF_CACHE_MISS = "proof.cache_miss"
+    PROOF_SERVED = "proof.served"
+    PROOF_PAIRINGS = "proof.pairings"
+    PROOF_VERIFY_BATCH = "proof.verify_batch"
     # 3PC
     BACKUP_ORDERED = "3pc.backup_ordered"
     ORDERED_BATCH_SIZE = "3pc.ordered_batch_size"

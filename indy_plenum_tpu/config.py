@@ -134,6 +134,19 @@ class Config:
     # depth at or above this fraction of capacity counts as queue growth
     # and narrows the tick.
     GovernorBackpressureQueueFrac: float = 0.5
+    # Read-path backpressure (ingress/read_service.py): bounded read
+    # queue with the same seeded drop-newest shed law as writes, so a
+    # read flood cannot starve the drain. 0 = unbounded (pre-proof-plane
+    # behaviour). The shed tiebreak shares IngressShedSeed.
+    IngressReadQueueCapacity: int = 0
+
+    # --- state-proof plane (proofs/) --------------------------------------
+    # Stabilized checkpoint windows whose pool multi-signature stays
+    # servable from the CheckpointProofCache; older windows GC with the
+    # checkpoint floor. 0 disables the proof plane (reads fall back to
+    # local-root proofs only). Nodes build the cache only when they also
+    # run a BLS replica — there is nothing to capture without one.
+    StateProofCacheWindows: int = 2
 
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory

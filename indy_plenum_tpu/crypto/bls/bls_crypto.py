@@ -138,6 +138,35 @@ class BlsCryptoSigner:
         return b58encode(g1_to_bytes(sig))
 
 
+class PairingCounter:
+    """Process-wide pairing accounting (the state-proof plane's cost
+    meter): ``checks`` counts pairing-equation evaluations (one shared
+    final exponentiation each), ``pairings`` the Miller loops they
+    contained. The proof plane's serve-path contract — a cache hit costs
+    ZERO pairings — is asserted against this counter by
+    ``scripts/check_dispatch_budget.py``'s proof gate and the ``proofs``
+    bench, so every verification path in this module must route through
+    :func:`_pairing_check`."""
+
+    __slots__ = ("checks", "pairings")
+
+    def __init__(self):
+        self.checks = 0
+        self.pairings = 0
+
+    def snapshot(self) -> tuple:
+        return (self.checks, self.pairings)
+
+
+PAIRINGS = PairingCounter()
+
+
+def _pairing_check(pairs) -> bool:
+    PAIRINGS.checks += 1
+    PAIRINGS.pairings += len(pairs)
+    return fast.pairing_check(pairs)
+
+
 # validator keys are static between NODE txns: memoize the expensive
 # subgroup membership checks (r*Q == O is a full scalar mul)
 _SUBGROUP_CACHE: Dict[str, bool] = {}
@@ -195,7 +224,7 @@ class BlsCryptoVerifier:
         if sig is None or pk is None:
             return False
         # e(H(m), pk) == e(sig, G2) <=> e(H(m), pk) * e(-sig, G2) == 1
-        return fast.pairing_check([
+        return _pairing_check([
             (hash_to_g1(message), pk),
             (bn.g1_neg(sig), bn.G2_GEN),
         ])
@@ -230,14 +259,15 @@ class BlsCryptoVerifier:
         acc = _aggregated_pk(pks_b58)
         if sig is None or acc is None:
             return False
-        return fast.pairing_check([
+        return _pairing_check([
             (hash_to_g1(message), acc),
             (bn.g1_neg(sig), bn.G2_GEN),
         ])
 
     @staticmethod
     def verify_multi_sig_batch(
-            items: Sequence[tuple]) -> List[bool]:
+            items: Sequence[tuple],
+            scalar_fn=None) -> List[bool]:
         """Verify k multi-signatures in (near) ONE pairing computation.
 
         ``items``: (signature_b58, message: bytes, pks_b58) per ordered
@@ -259,6 +289,12 @@ class BlsCryptoVerifier:
         Reference analog: crypto/bls/indy_crypto/bls_crypto_indy_crypto
         .py verifies one multi-sig per call; batching across ordered 3PC
         batches is the TPU-era redesign (SURVEY §2.3 / §7 step 6).
+
+        ``scalar_fn(idx, sig_b58, message) -> int`` overrides the scalar
+        source (the state-proof plane's SEEDED replay mode —
+        :func:`indy_plenum_tpu.proofs.batch_verify.verify_multi_sigs_batch`
+        documents when predictable scalars are safe). Default: fresh
+        ``secrets`` randomness, sound against adversarial input.
         """
         import secrets
 
@@ -279,7 +315,11 @@ class BlsCryptoVerifier:
             apk = _aggregated_pk(pks_b58)
             if sig is None or apk is None:
                 continue
-            r = int.from_bytes(secrets.token_bytes(16), "big")
+            r = (int.from_bytes(secrets.token_bytes(16), "big")
+                 if scalar_fn is None
+                 else scalar_fn(idx, sig_b58, message))
+            if r == 0:
+                r = 1  # a zero scalar would erase the item from the check
             h = hash_to_g1(message)
             by_apk.setdefault(tuple(pks_b58), (apk, []))[1].append(
                 (r, h, sig))
@@ -296,7 +336,7 @@ class BlsCryptoVerifier:
             agg_sig = fast.g1_sum(sig_terms)
             if agg_sig is not None:
                 pairs.append((bn.g1_neg(agg_sig), bn.G2_GEN))
-            if fast.pairing_check(pairs):
+            if _pairing_check(pairs):
                 for idx in parsed:
                     verdicts[idx] = True
                 return verdicts

@@ -40,7 +40,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 @dataclass
 class ProofRead:
     """One answered read: leaf bytes + the proof that they are in the
-    tree identified by ``root`` at ``tree_size``."""
+    tree identified by ``root`` at ``tree_size``. With the state-proof
+    plane attached, ``multi_sig`` carries the pool's BLS co-signature
+    over that root (participants ride inside the dict) and ``window``
+    the stabilized checkpoint window it was captured at — a client
+    holding only the pool's BLS keys verifies the whole reply via
+    :func:`indy_plenum_tpu.client.state_proof.verify_proved_read`."""
 
     index: int
     leaf: bytes
@@ -48,6 +53,8 @@ class ProofRead:
     path: List[bytes]
     tree_size: int
     verified: bool
+    multi_sig: Optional[dict] = None
+    window: Optional[Tuple[int, int]] = None
 
 
 class StaticCorpusBacking:
@@ -73,7 +80,16 @@ class StaticCorpusBacking:
     def leaf(self, index: int) -> bytes:
         return self._leaves[index]
 
-    def path(self, index: int) -> List[bytes]:
+    def path(self, index: int,
+             tree_size: Optional[int] = None) -> List[bytes]:
+        # the corpus is immutable: every snapshot IS the full tree, so a
+        # pinned window size can only ever equal self.tree_size — a
+        # mismatched pin (a mis-installed ProofWindow) must fail loudly,
+        # not hand out paths that silently verify False
+        if tree_size is not None and tree_size != self.tree_size:
+            raise ValueError(
+                f"static corpus has no size-{tree_size} snapshot "
+                f"(corpus size {self.tree_size})")
         cached = self._path_cache.get(index)
         if cached is None:
             cached = self._tree.audit_path(index, self.tree_size)
@@ -100,7 +116,9 @@ class LedgerBacking:
         self.tree_size = 0
         self.root = b""
         self.refreshes = 0
-        self._path_cache: Dict[int, List[bytes]] = {}
+        # index -> path at the live snapshot; (index, size) -> path at a
+        # pinned historical size (the proof plane's window roots)
+        self._path_cache: Dict[object, List[bytes]] = {}
         self.refresh()
         if bus is not None:
             from ..common.messages.internal_messages import (
@@ -128,23 +146,63 @@ class LedgerBacking:
         # also make proofs depend on re-serialization stability)
         return self._ledger.get_serialized(index + 1)
 
-    def path(self, index: int) -> List[bytes]:
-        cached = self._path_cache.get(index)
+    def path(self, index: int,
+             tree_size: Optional[int] = None) -> List[bytes]:
+        # ``tree_size`` pins a HISTORICAL snapshot (the state-proof
+        # plane serves the last stabilized window's root, which may
+        # trail the live tip mid-window); audit paths are per-tree-size,
+        # so pinned sizes key the cache alongside the index
+        if tree_size is None or tree_size == self.tree_size:
+            cached = self._path_cache.get(index)
+            if cached is None:
+                cached = self._ledger.audit_path(index + 1, self.tree_size)
+                self._path_cache[index] = cached
+            return cached
+        key = (index, tree_size)
+        cached = self._path_cache.get(key)
         if cached is None:
-            cached = self._ledger.audit_path(index + 1, self.tree_size)
-            self._path_cache[index] = cached
+            cached = self._ledger.audit_path(index + 1, tree_size)
+            self._path_cache[key] = cached
         return cached
+
+
+class _QueuedRead:
+    """Bounded-queue payload: gives one queued read the ``digest``
+    identity the admission controller's seeded rank law keys on (unique
+    per submission — the same index re-read later is a new arrival)."""
+
+    __slots__ = ("seq", "index", "digest")
+
+    def __init__(self, seq: int, index: int):
+        self.seq = seq
+        self.index = index
+        self.digest = "read|%d|%d" % (seq, index)
 
 
 class ReadService:
     """Batches GET-style reads and answers them with device-verified
     proofs. ``clock`` (the pool's virtual clock) timestamps the
     ``ingress.read`` trace marks so traces stay deterministic; the
-    wall-clock spent serving accumulates host-side only (``read_qps``)."""
+    wall-clock spent serving accumulates host-side only (``read_qps``).
+
+    ``proof_cache`` (a :class:`~indy_plenum_tpu.proofs.checkpoint_cache
+    .CheckpointProofCache`) attaches the state-proof plane: drains serve
+    against the LAST stabilized window's (size, root) snapshot and every
+    reply carries the pool's BLS multi-signature over that root — the
+    attach is a dict lookup, zero pairings on the serve path.
+
+    ``capacity`` > 0 bounds the read queue with the SAME deterministic
+    drop-newest shed law writes use (an
+    :class:`~indy_plenum_tpu.ingress.admission.AdmissionController`
+    seeded with ``seed``), so a read flood sheds deterministically
+    instead of starving the drain — ``ingress.read_shed`` /
+    ``ingress.read_queue_depth`` metrics segregate it from the write
+    side."""
 
     def __init__(self, backing, clock: Optional[Callable[[], float]] = None,
                  metrics=None, trace=None, max_batch: int = 16384,
-                 mode: str = "auto"):
+                 mode: str = "auto", proof_cache=None,
+                 capacity: int = 0, seed: int = 0):
         from ..common.metrics_collector import MetricsCollector
         from ..observability.trace import NULL_TRACE
 
@@ -156,59 +214,115 @@ class ReadService:
         # (the round-4 offload lesson, applied to reads)
         self.mode = mode
         self.backing = backing
+        self.proof_cache = proof_cache
         self._clock = clock if clock is not None else (lambda: 0.0)
         self.metrics = metrics if metrics is not None \
             else MetricsCollector()
         self.trace = trace if trace is not None else NULL_TRACE
         self.max_batch = int(max_batch)
         self._queue: List[int] = []
+        self.admission = None
+        if capacity > 0:
+            from .admission import AdmissionController
+
+            self.admission = AdmissionController(
+                capacity=capacity, seed=seed, clock=self._clock)
+        self._read_seq = 0
         self.served_total = 0
         self.verified_total = 0
+        self.proofs_attached_total = 0
         self.serve_wall_s = 0.0
 
     # ------------------------------------------------------------------
 
     @property
     def depth(self) -> int:
+        if self.admission is not None:
+            return self.admission.depth
         return len(self._queue)
 
-    def submit(self, index: int) -> None:
+    @property
+    def shed_total(self) -> int:
+        return self.admission.shed_total if self.admission else 0
+
+    def shed_hash(self) -> str:
+        """The read-shed fingerprint (bounded mode), same contract as
+        the write side's ``AdmissionController.shed_hash``."""
+        if self.admission is None:
+            import hashlib
+
+            return hashlib.sha256(b"").hexdigest()
+        return self.admission.shed_hash()
+
+    def submit(self, index: int) -> bool:
         """Queue one read for the next drain; ``index`` is folded into
         the backing's tree (the workload generator's key space may be
-        larger than the corpus)."""
+        larger than the corpus). Returns whether the read is queued NOW
+        (always True unbounded; in bounded mode a shed read returns
+        False and its drop settles in the drain's accounting)."""
         size = self.backing.tree_size
         if size <= 0:
             raise ValueError("read backing is empty")
-        self._queue.append(index % size)
+        idx = index % size
+        if self.admission is None:
+            self._queue.append(idx)
+            return True
+        self._read_seq += 1
+        return self.admission.offer(_QueuedRead(self._read_seq, idx))
 
     def read_one(self, index: int) -> ProofRead:
         """Synchronous single read (tests / interactive use): the proof
         still verifies — through the host tier below DEVICE_MIN_BATCH.
         Anything already queued drains too; the reply for ``index`` is
         the LAST one (drain answers in submission order)."""
-        self.submit(index)
+        if not self.submit(index):
+            raise RuntimeError("read shed by backpressure")
         return self.drain()[-1]
 
     def drain(self) -> List[ProofRead]:
         """Answer everything queued: gather leaves + cached paths, then
         ONE batched audit-proof verification per ``max_batch`` chunk.
-        Returns the replies in submission order."""
-        if not self._queue:
-            return []
+        Returns the replies in submission order. In bounded mode the
+        drain also settles the shed accounting (``ingress.read_shed`` /
+        ``ingress.read_queue_depth``); with a proof cache attached, the
+        replies serve the last stabilized window's root and carry its
+        pool multi-signature."""
         from ..common.metrics_collector import MetricsName
+
+        if self.admission is not None:
+            self.metrics.add_event(MetricsName.READ_QUEUE_DEPTH,
+                                   self.admission.depth)
+            batch, shed = self.admission.drain()
+            queued = [r.index for r in batch]
+            if shed:
+                self.metrics.add_event(MetricsName.READ_SHED, len(shed))
+        else:
+            queued, self._queue = self._queue, []
+        if not queued:
+            return []
         from ..server.catchup.catchup_rep_service import (
             verify_audit_paths_batch,
         )
 
-        queued, self._queue = self._queue, []
         backing = self.backing
         root, tree_size = backing.root, backing.tree_size
+        ms_dict = window = None
+        if self.proof_cache is not None:
+            entry = self.proof_cache.attach(len(queued))
+            if entry is not None:
+                # the window snapshot, NOT the live tip: mid-window
+                # commits stay unserved until the next stabilization, so
+                # every reply's root is one the pool co-signed
+                root, tree_size = entry.root, entry.tree_size
+                ms_dict, window = entry.multi_sig_dict, entry.window
         out: List[ProofRead] = []
         t0 = time.perf_counter()
         for lo in range(0, len(queued), self.max_batch):
-            chunk = queued[lo:lo + self.max_batch]
+            # re-fold into the SERVING snapshot: submit() folded into the
+            # live tree, which may have grown past the proven window
+            chunk = [i % tree_size for i in queued[lo:lo + self.max_batch]]
             leaves = [backing.leaf(i) for i in chunk]
-            paths = [backing.path(i) for i in chunk]
+            paths = [backing.path(i, tree_size) for i in chunk]
             verdicts = verify_audit_paths_batch(
                 leaves, chunk, paths, tree_size, root, mode=self.mode)
             ok = int(verdicts.sum())
@@ -221,9 +335,12 @@ class ReadService:
                                            verdicts):
                 out.append(ProofRead(
                     index=i, leaf=leaf, root=root, path=path,
-                    tree_size=tree_size, verified=bool(good)))
+                    tree_size=tree_size, verified=bool(good),
+                    multi_sig=ms_dict, window=window))
         self.serve_wall_s += time.perf_counter() - t0
         self.served_total += len(queued)
+        if ms_dict is not None:
+            self.proofs_attached_total += len(queued)
         self.metrics.add_event(MetricsName.READ_BATCH_SIZE, len(queued))
         self.metrics.add_event(MetricsName.READ_SERVED, len(queued))
         if self.serve_wall_s > 0:
@@ -237,10 +354,15 @@ class ReadService:
     def counters(self) -> Dict[str, object]:
         qps = (self.served_total / self.serve_wall_s
                if self.serve_wall_s > 0 else 0.0)
-        return {
+        out = {
             "served": self.served_total,
             "verified": self.verified_total,
             "pending": self.depth,
             "serve_wall_s": round(self.serve_wall_s, 4),
             "read_qps": round(qps, 1),
+            "proofs_attached": self.proofs_attached_total,
         }
+        if self.admission is not None:
+            out["shed"] = self.admission.shed_total
+            out["capacity"] = self.admission.capacity
+        return out

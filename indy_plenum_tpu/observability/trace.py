@@ -68,6 +68,12 @@ PHASES: Tuple[Tuple[str, str, str], ...] = (
     ("total_3pc", "3pc.preprepare", "3pc.executed"),
 )
 AUTH_PHASE = ("auth", "req.ingress", "req.finalised")
+# state-proof plane: a checkpoint boundary batch's ordering → its
+# window's pool proof becoming servable (CheckpointProofCache capture).
+# Joined per node on (view_no, seq_no_end) — the window key IS the
+# boundary batch's (view, pp_seq), so the sample measures exactly the
+# stabilization wait a proved read pays before a root is servable.
+PROOF_PHASE = ("proof", "3pc.ordered", "proof.window_signed")
 
 
 class TraceRecorder:
@@ -306,6 +312,30 @@ def phase_durations(events: List[Dict[str, Any]],
         if k in ingress_ts:
             out.setdefault(AUTH_PHASE[0], []).append(
                 finalised_ts[k] - ingress_ts[k])
+    # proof phase: per node, each proof.window_signed (key (view, seq))
+    # joins the SAME node's earliest 3pc.ordered mark for the boundary
+    # batch (key (view, seq, digest)) — the stabilization wait between
+    # a window's last batch ordering and its pool proof being servable
+    ordered_at: Dict[tuple, float] = {}
+    for ev in events:
+        if ev.get("cat") != "3pc" or ev["name"] != PROOF_PHASE[1] \
+                or ev.get("key") is None or len(ev["key"]) < 2:
+            continue
+        if node is not None and ev.get("node", "") != node:
+            continue
+        k = (ev.get("node", ""), ev["key"][0], ev["key"][1])
+        if k not in ordered_at or ev["ts"] < ordered_at[k]:
+            ordered_at[k] = ev["ts"]
+    for ev in events:
+        if ev.get("cat") != "proof" or ev["name"] != PROOF_PHASE[2] \
+                or ev.get("key") is None or len(ev["key"]) < 2:
+            continue
+        if node is not None and ev.get("node", "") != node:
+            continue
+        t0 = ordered_at.get(
+            (ev.get("node", ""), ev["key"][0], ev["key"][1]))
+        if t0 is not None:
+            out.setdefault(PROOF_PHASE[0], []).append(ev["ts"] - t0)
     return out
 
 

@@ -225,8 +225,34 @@ class Monitor:
             read_qps = self._metrics.stat(MetricsName.READ_QPS)
             if read_qps is not None:
                 ingress["read_qps"] = round(read_qps.last, 1)
+            # read-path backpressure (state-proof plane satellite): the
+            # read queue's own bounded-queue numbers, segregated from
+            # the write side's
+            read_depth = self._metrics.stat(MetricsName.READ_QUEUE_DEPTH)
+            if read_depth is not None:
+                ingress["read_queue_depth"] = {"current": read_depth.last,
+                                               "max": read_depth.max}
+            read_shed = self._metrics.stat(MetricsName.READ_SHED)
+            if read_shed is not None:
+                ingress["read_shed"] = int(read_shed.total)
             if ingress:
                 snap["ingress"] = ingress
+            # state-proof plane: windows captured, serve-path hit/miss
+            # split, reads served WITH a pool proof, and the pairing
+            # work the batched verifier performed — absent entirely when
+            # the run never recorded proof metrics (plane off)
+            proofs = {}
+            for label, name in (
+                    ("windows_signed", MetricsName.PROOF_WINDOWS_SIGNED),
+                    ("cache_hits", MetricsName.PROOF_CACHE_HIT),
+                    ("cache_misses", MetricsName.PROOF_CACHE_MISS),
+                    ("proofs_served", MetricsName.PROOF_SERVED),
+                    ("pairings", MetricsName.PROOF_PAIRINGS)):
+                stat = self._metrics.stat(name)
+                if stat is not None:
+                    proofs[label] = int(stat.total)
+            if proofs:
+                snap["proofs"] = proofs
         if self._trace is not None and self._trace.enabled:
             # per-phase latency attribution (flight recorder): where this
             # node's ordered batches spent their time — prepare / commit

@@ -273,6 +273,34 @@ class Node:
                 pool_state_root_provider=pool_root,
                 suspicion_sink=bls_suspicion)
 
+        # --- state-proof plane ------------------------------------------
+        # per stabilized checkpoint window, cache the pool's BLS
+        # multi-sig over the committed domain roots (consensus already
+        # aggregated it) and serve externally-verifiable reads against
+        # that window via a proof-attaching ReadService. The client
+        # reply surface still serves SMT reads (read_manager); wiring
+        # ReadService into it is the ROADMAP phase-2 item — the service
+        # here is the bench/scripts/pool surface.
+        self.proof_cache = None
+        self.read_service = None
+        if self.bls_replica is not None \
+                and self.config.StateProofCacheWindows > 0:
+            from ..ingress.read_service import LedgerBacking, ReadService
+            from ..proofs import CheckpointProofCache
+
+            self.proof_cache = CheckpointProofCache.for_domain(
+                self.boot.db, self.bls_replica, bus=self.internal_bus,
+                keep=self.config.StateProofCacheWindows,
+                clock=timer.get_current_time,
+                metrics=self.metrics, trace=self.trace, node=name)
+            self.read_service = ReadService(
+                LedgerBacking(self.boot.db.get_ledger(DOMAIN_LEDGER_ID),
+                              bus=self.internal_bus),
+                clock=timer.get_current_time, metrics=self.metrics,
+                trace=self.trace, proof_cache=self.proof_cache,
+                capacity=self.config.IngressReadQueueCapacity,
+                seed=self.config.IngressShedSeed)
+
         # --- consensus services -----------------------------------------
         self.ordering = OrderingService(
             data=self.data, timer=timer, bus=self.internal_bus,

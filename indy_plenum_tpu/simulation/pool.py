@@ -146,7 +146,7 @@ class SimNode:
                  domain_genesis: Optional[list] = None,
                  storage=None, bls_keys=None,
                  shadow_check: Optional[bool] = None,
-                 vote_plane=None, trace=None):
+                 vote_plane=None, trace=None, metrics=None):
         # shadow_check default: on whenever the device plane decides, so
         # tests continuously prove host/device equivalence. The bench turns
         # it off to run the device plane as the SOLE quorum authority.
@@ -268,6 +268,21 @@ class SimNode:
             network=self.external_bus, ordering_service=self.ordering,
             view_change_service=self.view_changer)
 
+        # state-proof plane: per stabilized checkpoint window, capture
+        # the pool's BLS multi-sig over the committed roots (already
+        # aggregated by consensus) so proved reads attach it for free —
+        # rides the same CheckpointStabilized hook as LedgerBacking
+        self.proof_cache = None
+        if self.boot is not None and self.bls_replica is not None \
+                and config.StateProofCacheWindows > 0:
+            from ..proofs import CheckpointProofCache
+
+            self.proof_cache = CheckpointProofCache.for_domain(
+                self.boot.db, self.bls_replica, bus=self.internal_bus,
+                keep=config.StateProofCacheWindows,
+                clock=timer.get_current_time,
+                metrics=metrics, trace=self.trace, node=name)
+
         # catchup plane (requires real ledgers): every node seeds; the
         # leecher consumes NeedMasterCatchup from the checkpoint service
         self.seeder = None
@@ -363,6 +378,7 @@ class SimPool:
                  trace_capacity: Optional[int] = None):
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
+        self.seed = seed
         self.timer = MockTimer(start_time=1_700_000_000.0)
         self.metrics = MetricsCollector()
         # consensus flight recorder: one pool-shared ring on the VIRTUAL
@@ -456,7 +472,7 @@ class SimPool:
                     bls_keys=self.bls_keys, shadow_check=shadow_check,
                     vote_plane=(self.vote_group.view(i * k)
                                 if self.vote_group else None),
-                    trace=self.trace)
+                    trace=self.trace, metrics=self.metrics)
             for i, name in enumerate(self.validators)]
         self.network.connect_all()
 
@@ -701,6 +717,28 @@ class SimPool:
             shed_delta=self._last_ingress_shed,
             leeching=any(not nd.data.is_participating
                          for nd in self.nodes))
+
+    def make_read_service(self, name: str = "node0", mode: str = "host",
+                          capacity: int = 0):
+        """A proof-serving :class:`~indy_plenum_tpu.ingress.read_service
+        .ReadService` over ``name``'s committed domain ledger (requires
+        real_execution): the backing rides the node's checkpoint-
+        stabilized hook and, when the node runs the state-proof plane,
+        replies carry the pool's window multi-signature. ``capacity``
+        bounds the read queue (seeded with the POOL seed, like the write
+        side)."""
+        from ..ingress.read_service import LedgerBacking, ReadService
+
+        node = self.node(name)
+        assert node.boot is not None, "make_read_service needs real ledgers"
+        backing = LedgerBacking(
+            node.boot.db.get_ledger(DOMAIN_LEDGER_ID),
+            bus=node.internal_bus)
+        return ReadService(
+            backing, clock=self.timer.get_current_time,
+            metrics=self.metrics, trace=self.trace, mode=mode,
+            proof_cache=node.proof_cache, capacity=capacity,
+            seed=self.config.IngressShedSeed or self.seed)
 
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)

@@ -45,6 +45,16 @@ ordered/sim-second under saturation collapses more than
 ``--ingress-tolerance`` below the unsaturated run (admission exists to
 protect goodput, not to trade it away).
 
+Proof gate (PR 10): unless ``--no-proof-gate``, the script runs the same
+seeded real-execution BLS pool twice — once idle, once serving
+proof-attached reads through the state-proof plane — and fails if the
+ordered digests diverge (reads never perturb consensus), if serving
+cache-hit reads performed ANY pairing work (the serve path must be a
+dict lookup), if any reply fails client-side end-to-end verification
+(``verify_proved_read`` with only the pool's BLS keys), or if the
+batched multi-sig verifier falls below 2x the per-root path at batch 64
+(the whole point of batching pairings across roots/windows).
+
 Fabric gate (PR 9): unless ``--no-fabric-gate``, the script runs the
 n=16/k=6 workload on the 2-axis member x validator fabric (half the
 sharded gate's devices on each axis) and compares it against the 1-axis
@@ -567,6 +577,120 @@ def ingress_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def proof_gate(args) -> "tuple[dict, list]":
+    """State-proof plane gate: (1) the SAME seeded real-execution BLS
+    pool with and without proof-serving reads must order bit-identical
+    digests; (2) serving cache-hit reads must perform ZERO pairing
+    checks (``crypto.bls.bls_crypto.PAIRINGS``) — the window's
+    aggregation was already paid by consensus; (3) every reply must
+    verify end-to-end with only the pool's BLS keys; (4) the batched
+    pairing verifier must hold >= ``--proof-speedup-floor`` x the
+    per-root path at batch 64."""
+    import hashlib as _hashlib
+
+    from indy_plenum_tpu.client.state_proof import verify_proved_read
+    from indy_plenum_tpu.crypto.bls.bls_crypto import (
+        PAIRINGS,
+        BlsCryptoSigner,
+        BlsCryptoVerifier,
+        BlsKeyPair,
+    )
+    from indy_plenum_tpu.proofs import verify_multi_sigs_batch
+
+    def run(serve_reads: bool) -> dict:
+        config = getConfig({
+            "CHK_FREQ": 5, "LOG_SIZE": 15,
+            "Max3PCBatchSize": 1, "Max3PCBatchWait": 0.05,
+        })
+        pool = SimPool(4, seed=args.seed, config=config,
+                       real_execution=True, bls=True)
+        for i in range(8):
+            pool.submit_request(i)
+        deadline = time.monotonic() + 240
+        while min(len(nd.ordered_digests) for nd in pool.nodes) < 8 \
+                and time.monotonic() < deadline:
+            pool.run_for(0.5)
+        assert pool.honest_nodes_agree()
+        out = {"ordered_hash": pool.ordered_hash(),
+               "windows_signed":
+                   pool.nodes[0].proof_cache.windows_signed}
+        if serve_reads:
+            rs = pool.make_read_service("node0", mode="host")
+            for i in range(32):
+                rs.submit(i)
+            checks0 = PAIRINGS.checks
+            replies = rs.drain()
+            out["serve_pairing_checks"] = PAIRINGS.checks - checks0
+            pool_keys = {n: pk
+                         for n, (kp, pk, pop) in pool.bls_keys.items()}
+            out["reads"] = len(replies)
+            out["reads_with_proof"] = sum(
+                1 for r in replies if r.multi_sig is not None)
+            out["reads_client_verified"] = sum(
+                1 for r in replies
+                if verify_proved_read(r, pool_keys, min_participants=3))
+        return out
+
+    idle = run(serve_reads=False)
+    serving = run(serve_reads=True)
+    failures = []
+    if serving["ordered_hash"] != idle["ordered_hash"]:
+        failures.append("proof-serving ordered digests diverge from the "
+                        "idle run (reads perturbed consensus)")
+    if serving["windows_signed"] < 1:
+        failures.append("no checkpoint window captured a pool proof "
+                        "(the CheckpointStabilized hook is dead)")
+    if serving.get("serve_pairing_checks", 0) != 0:
+        failures.append(
+            f"cache-hit serve path performed "
+            f"{serving['serve_pairing_checks']} pairing checks "
+            "(must be a dict lookup — zero pairings)")
+    if serving.get("reads_with_proof") != serving.get("reads"):
+        failures.append(
+            f"{serving.get('reads', 0) - serving.get('reads_with_proof', 0)}"
+            " replies missing the pool multi-signature")
+    if serving.get("reads_client_verified") != serving.get("reads"):
+        failures.append("replies failed client-side verify_proved_read")
+
+    # batched vs per-root pairing throughput at batch 64 (synthetic
+    # windows: 8 validators, 64 roots — the batching claim is about
+    # amortizing pairings ACROSS roots, not about the validator count)
+    kps = [BlsKeyPair(_hashlib.sha256(b"proof-gate-%d" % i).digest())
+           for i in range(8)]
+    pks = [kp.pk_b58 for kp in kps]
+    items = []
+    for j in range(64):
+        msg = b"window-root-%d|%d" % (j, args.seed)
+        items.append((BlsCryptoVerifier.aggregate_sigs(
+            [BlsCryptoSigner(kp).sign(msg) for kp in kps]), msg, pks))
+    # warm both paths (subgroup/apk caches) before timing
+    assert BlsCryptoVerifier.verify_multi_sig(*items[0])
+    assert all(verify_multi_sigs_batch(items[:2], seed=args.seed))
+    t0 = time.perf_counter()
+    per_root_ok = [BlsCryptoVerifier.verify_multi_sig(*it)
+                   for it in items]
+    per_root_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batched = verify_multi_sigs_batch(items, seed=args.seed)
+    batch_s = time.perf_counter() - t0
+    assert all(per_root_ok) and all(batched)
+    speedup = per_root_s / batch_s if batch_s else 0.0
+    if speedup < args.proof_speedup_floor:
+        failures.append(
+            f"batch-64 verify speedup {speedup:.2f}x below floor "
+            f"{args.proof_speedup_floor}x vs the per-root path")
+    record = {
+        "idle": idle,
+        "serving": serving,
+        "digests_match": serving["ordered_hash"] == idle["ordered_hash"],
+        "per_root_64_s": round(per_root_s, 4),
+        "batch_64_s": round(batch_s, 4),
+        "batch_speedup": round(speedup, 2),
+        "proof_speedup_floor": args.proof_speedup_floor,
+    }
+    return record, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -593,6 +717,13 @@ def main() -> int:
     ap.add_argument("--no-fabric-gate", action="store_true",
                     help="skip the 1-axis vs 2-axis quorum-fabric "
                          "comparison")
+    ap.add_argument("--no-proof-gate", action="store_true",
+                    help="skip the state-proof plane gate (ordered-hash "
+                         "identity, zero serve-path pairings, client "
+                         "verify, batched-verify speedup)")
+    ap.add_argument("--proof-speedup-floor", type=float, default=2.0,
+                    help="min batch-64 multi-sig verify speedup vs the "
+                         "per-root path")
     ap.add_argument("--fabric-tolerance", type=float, default=0.10,
                     help="max fractional dispatches/ordered-batch and "
                          "bytes/readback drift the 2-axis fabric run "
@@ -684,6 +815,10 @@ def main() -> int:
     if not args.no_ingress_gate:
         record, failures = ingress_gate(args)
         result["ingress_gate"] = record
+        over.extend(failures)
+    if not args.no_proof_gate:
+        record, failures = proof_gate(args)
+        result["proof_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

@@ -8,6 +8,7 @@ virtual clock (:mod:`.scheduler`), PBFT safety/liveness assertions
 """
 from .faults import (  # noqa: F401
     ClockSkewFault,
+    CorruptCatchupRepFault,
     CorruptOrderedLogFault,
     CrashFault,
     DelayFault,

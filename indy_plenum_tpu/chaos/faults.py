@@ -318,6 +318,47 @@ class EquivocateFault(Fault):
 
 
 @dataclass
+class CorruptCatchupRepFault(Fault):
+    """Byzantine seeder: every ``CATCHUP_REP`` the node serves carries
+    silently-corrupted txn payloads (the audit paths still reference the
+    honest tree, so the leecher's batched proof verification MUST reject
+    the whole slice, raise CATCHUP_REP_WRONG suspicion, and re-request
+    from an honest seeder — corrupted history must never apply). The node
+    stays honest in 3PC; only its catchup answers lie."""
+
+    node: str = ""
+
+    def begin(self, ctx: FaultContext) -> Undo:
+        CatchupRep = _node_messages.CatchupRep
+        bus = ctx.pool.node(self.node).external_bus
+        original = bus._send_handler
+
+        def corrupt(msg, dst=None):
+            if not isinstance(msg, CatchupRep):
+                return original(msg, dst)
+            forged = msg._fields
+            forged["txns"] = {
+                seq: {**txn, "evil": "corrupted-by-" + self.node}
+                if isinstance(txn, dict) else txn
+                for seq, txn in dict(msg.txns).items()}
+            ctx.trace(f"{self.node} corrupting CATCHUP_REP "
+                      f"({len(forged['txns'])} txns, ledger "
+                      f"{msg.ledgerId})")
+            return original(CatchupRep(**forged), dst)
+
+        bus._send_handler = corrupt
+
+        def undo():
+            bus._send_handler = original
+
+        return undo
+
+    @property
+    def byzantine_nodes(self) -> FrozenSet[str]:
+        return frozenset({self.node})
+
+
+@dataclass
 class CorruptOrderedLogFault(Fault):
     """Deliberately-broken adversary: silently rewrite the victim's LAST
     executed batch digest, modelling an undetected ordering/execution bug
@@ -364,6 +405,16 @@ class FaultPlan:
         out: FrozenSet[str] = frozenset()
         for fault in self.faults:
             if fault.crashed_nodes and fault.duration is None:
+                out |= fault.crashed_nodes
+        return out
+
+    @property
+    def restarted_nodes(self) -> FrozenSet[str]:
+        """Crashed WITH a restart: the nodes a catchup scenario expects
+        to detect their gap, leech it back, and rejoin ordering."""
+        out: FrozenSet[str] = frozenset()
+        for fault in self.faults:
+            if fault.crashed_nodes and fault.duration is not None:
                 out |= fault.crashed_nodes
         return out
 

@@ -85,8 +85,21 @@ class InvariantChecker:
             f"{len(by_seq)} seqNos, single digest each across "
             f"{len(self.honest_nodes)} honest replicas")
 
+    def _ordered_seq(self, node: Any) -> tuple:
+        """One node's ordering fingerprint sequence. Real-execution nodes
+        use the committed domain ledger's request-digest sequence: a node
+        that CAUGHT UP across a GC'd window never saw the leeched range's
+        ``Ordered`` events, but the fetched txns carry the original
+        request digests — the ledger IS its ordering record, comparable
+        bit-for-bit against the survivors. Executor-faked pools keep the
+        ordered_log view."""
+        if getattr(node, "boot", None) is not None \
+                and hasattr(type(node), "committed_request_digests"):
+            return tuple(node.committed_request_digests)
+        return tuple(node.ordered_digests)
+
     def check_ordered_prefix(self) -> InvariantResult:
-        logs = {n.name: tuple(n.ordered_digests)
+        logs = {n.name: self._ordered_seq(n)
                 for n in self.honest_nodes}
         longest_name = max(logs, key=lambda name: len(logs[name]))
         longest = logs[longest_name]

@@ -32,6 +32,14 @@ class ChaosReport:
     ordered_hash_per_node: Dict[str, str] = field(default_factory=dict)
     # RBFT monitor views, for pools whose nodes carry one (NodePool)
     monitor_per_node: Dict[str, Any] = field(default_factory=dict)
+    # catchup plane (real-execution scenarios): per-node leecher meters
+    # (rounds / txns leeched / proofs verified / reps rejected / retry-law
+    # re-requests), per-node committed-ledger hashes — the ordering
+    # fingerprint that stays comparable across catchup, asserted
+    # bit-identical by the budget script's catchup gate — and the
+    # proof-read closing check (the freshly caught-up node serving a
+    # verify_proved_read-able reply from the window it just leeched)
+    catchup: Dict[str, Any] = field(default_factory=dict)
     byzantine_nodes: List[str] = field(default_factory=list)
     periodic_checks: int = 0
     first_violation: Optional[Tuple[float, str]] = None
@@ -100,6 +108,7 @@ class ChaosReport:
             "ordered_per_node": self.ordered_per_node,
             "ordered_hash_per_node": self.ordered_hash_per_node,
             "monitor_per_node": self.monitor_per_node,
+            "catchup": self.catchup,
             "periodic_checks": self.periodic_checks,
             "first_violation": (list(self.first_violation)
                                 if self.first_violation else None),
@@ -132,6 +141,19 @@ class ChaosReport:
         if self.first_violation is not None:
             t, what = self.first_violation
             lines.append(f"  first violation at t={t:.2f}: {what}")
+        if self.catchup:
+            lines.append(
+                f"  catchup: rounds={self.catchup.get('rounds')} "
+                f"txns_leeched={self.catchup.get('txns_leeched')} "
+                f"proofs_verified={self.catchup.get('proofs_verified')} "
+                f"reps_rejected={self.catchup.get('reps_rejected')} "
+                f"retries={self.catchup.get('retries')}")
+            pr = self.catchup.get("proof_read")
+            if pr:
+                lines.append(
+                    f"  proof read: node={pr.get('node')} "
+                    f"index={pr.get('index')} window={pr.get('window')} "
+                    f"verified={pr.get('verified')}")
         if self.trace_hash is not None:
             dumped = ", ".join(sorted({d.get("reason", "?")
                                        for d in self.flight_recorder})) \

@@ -11,11 +11,12 @@ at the end) and emit a replayable :class:`ChaosReport`.
 from __future__ import annotations
 
 import hashlib
-from typing import Optional
+from typing import Dict, Optional
 
 from ..config import getConfig
 from ..simulation.pool import SimPool
-from .invariants import InvariantChecker
+from .faults import CrashFault
+from .invariants import InvariantChecker, InvariantResult
 from .report import ChaosReport
 from .scenarios import Scenario, get_scenario
 from .scheduler import FaultScheduler
@@ -25,11 +26,13 @@ from .scheduler import FaultScheduler
 BASE_CONFIG = {
     "Max3PCBatchWait": 0.1,
     "Max3PCBatchSize": 5,
-    # keep the WHOLE run inside one checkpoint window: plain SimPool has
-    # no ledger catchup, so a replica that falls behind a stabilized
-    # checkpoint could never re-sync — recovery during chaos runs rides
-    # 3PC re-request + NEW_VIEW re-ordering, both of which need peers to
-    # still hold the logs
+    # keep executor-faked runs inside one checkpoint window: without real
+    # ledgers there is no catchup, so a replica that falls behind a
+    # stabilized checkpoint could never re-sync — recovery rides 3PC
+    # re-request + NEW_VIEW re-ordering, both of which need peers to
+    # still hold the logs. Catchup scenarios (real_execution=True)
+    # OVERRIDE this with tiny windows on purpose: crossing a GC'd
+    # checkpoint boundary and leeching back is exactly what they test.
     "CHK_FREQ": 50,
     "LOG_SIZE": 150,
     # tight PBFT stall timer: chaos runs stall pools on purpose and the
@@ -37,6 +40,122 @@ BASE_CONFIG = {
     # what the liveness invariant exercises
     "OrderingStallTimeout": 4.0,
 }
+
+
+def _catchup_block(pool, plan, scenario, leech_floor) -> dict:
+    """The report's catchup forensic record: per-node leecher meters,
+    pool totals, per-node committed-ledger hashes (the ordering
+    fingerprint that stays comparable ACROSS catchup — a caught-up
+    node's ordered_log legitimately skips the leeched middle), and the
+    proof-read closing check when the scenario requests it."""
+    leechers = {nd.name: nd.leecher for nd in pool.nodes
+                if getattr(nd, "leecher", None) is not None}
+    if not leechers:
+        return {}
+    per_node = {name: l.catchup_stats() for name, l in leechers.items()}
+    totals = {k: sum(stats[k] for stats in per_node.values())
+              for k in ("rounds_completed", "txns_leeched",
+                        "proofs_verified", "reps_rejected", "retries")}
+    block = {
+        "per_node": per_node,
+        "rounds": totals["rounds_completed"],
+        "txns_leeched": totals["txns_leeched"],
+        "proofs_verified": totals["proofs_verified"],
+        "reps_rejected": totals["reps_rejected"],
+        "retries": totals["retries"],
+        "restarted_nodes": sorted(plan.restarted_nodes),
+        "leech_floor": dict(leech_floor),
+        "ledger_hash_per_node": {nd.name: pool.ledger_hash(nd.name)
+                                 for nd in pool.nodes},
+    }
+    if scenario.proof_read and pool.bls_keys is not None \
+            and plan.restarted_nodes:
+        from ..client.state_proof import verify_proved_read
+
+        victim = sorted(plan.restarted_nodes)[0]
+        # read a leaf from INSIDE the leeched range (0-based index =
+        # the victim's committed size at restart = first leeched seq-1),
+        # served by the victim itself against the stabilized window it
+        # captured after rejoining — the window's tree COVERS the range
+        # it just leeched
+        index = leech_floor.get(victim, 0)
+        service = pool.make_read_service(victim, mode="auto")
+        service.submit(index)
+        replies = service.drain()
+        reply = replies[-1] if replies else None
+        n = len(pool.validators)
+        quorum = n - (n - 1) // 3
+        keys = {name: pk for name, (kp, pk, pop) in pool.bls_keys.items()}
+        verified = bool(
+            reply is not None and reply.multi_sig is not None
+            and verify_proved_read(reply, keys, min_participants=quorum))
+        block["proof_read"] = {
+            "node": victim,
+            "index": index,
+            "window": list(reply.window) if reply is not None
+            and reply.window is not None else None,
+            "has_multi_sig": bool(reply is not None
+                                  and reply.multi_sig is not None),
+            "verified": verified,
+        }
+    return block
+
+
+def _catchup_verdicts(pool, plan, scenario, block) -> list:
+    """The scenario's catchup requirements as first-class invariant
+    results — ASSERTED from the leecher meters and the client-side
+    proof verdict, so a chaos run can never 'pass' by silently skipping
+    recovery."""
+    out = []
+    if scenario.require_catchup:
+        problems = []
+        if not plan.restarted_nodes:
+            problems.append("no crashed-and-restarted node in the plan")
+        for victim in sorted(plan.restarted_nodes):
+            stats = (block.get("per_node") or {}).get(victim)
+            if stats is None:
+                problems.append(f"{victim} has no leecher")
+                continue
+            if stats["rounds_completed"] < 1:
+                problems.append(f"{victim} completed no catchup round")
+            if stats["txns_leeched"] < 1:
+                problems.append(f"{victim} leeched no txns")
+            if stats["proofs_verified"] < stats["txns_leeched"]:
+                problems.append(
+                    f"{victim} applied {stats['txns_leeched']} txns but "
+                    f"proof-verified only {stats['proofs_verified']}")
+            if not pool.node(victim).data.is_participating:
+                problems.append(f"{victim} is not participating again")
+        out.append(InvariantResult(
+            "catchup_recovery", not problems,
+            "; ".join(problems) if problems else
+            f"restarted {sorted(plan.restarted_nodes)} completed "
+            f"{block.get('rounds', 0)} round(s), "
+            f"{block.get('txns_leeched', 0)} txns leeched, "
+            f"{block.get('proofs_verified', 0)} proofs verified"))
+    if scenario.require_rejection:
+        rejected = block.get("reps_rejected", 0)
+        out.append(InvariantResult(
+            "catchup_rejection", rejected >= 1,
+            f"{rejected} corrupted CATCHUP_REP(s) rejected by proof "
+            "verification" if rejected else
+            "no CATCHUP_REP was rejected — the byzantine seeder was "
+            "never exercised (or its corruption was trusted)"))
+    if scenario.require_retries:
+        retries = block.get("retries", 0)
+        out.append(InvariantResult(
+            "catchup_retry", retries >= 1,
+            f"retry law re-requested {retries} slice(s)" if retries else
+            "no retry fired — the silent seeder was never exercised"))
+    if scenario.proof_read:
+        pr = block.get("proof_read") or {}
+        out.append(InvariantResult(
+            "catchup_proof_read", bool(pr.get("verified")),
+            f"caught-up node {pr.get('node')} served index "
+            f"{pr.get('index')} from window {pr.get('window')}; "
+            "verify_proved_read against the pool BLS keys: "
+            f"{bool(pr.get('verified'))}"))
+    return out
 
 
 def run_scenario(scenario: "str | Scenario", seed: int,
@@ -92,7 +211,10 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     config = getConfig(overrides)
     pool = SimPool(n_nodes=n, seed=seed, config=config,
                    device_quorum=device_quorum, mesh=mesh,
-                   host_eval=host_eval, trace=trace)
+                   host_eval=host_eval, trace=trace,
+                   real_execution=scenario.real_execution,
+                   bls=scenario.bls,
+                   num_instances=scenario.num_instances)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,
@@ -112,6 +234,24 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             lambda seq=scenario.initial_requests + i:
             pool.submit_request(seq))
 
+    # catchup scenarios: snapshot each restarted victim's committed
+    # ledger size at its restart instant — the leeched range starts
+    # there, and the proof-read check reads from INSIDE it
+    leech_floor: Dict[str, int] = {}
+    if scenario.real_execution:
+        from ..common.constants import DOMAIN_LEDGER_ID
+
+        def _snap_floor(victim: str) -> None:
+            node = pool.node(victim)
+            if node.boot is not None:
+                leech_floor[victim] = node.boot.db.get_ledger(
+                    DOMAIN_LEDGER_ID).size
+
+        for fault in plan.faults:
+            if isinstance(fault, CrashFault) and fault.duration is not None:
+                pool.timer.schedule(fault.at + fault.duration,
+                                    lambda v=fault.node: _snap_floor(v))
+
     # run past the last bounded fault, then let the pool settle
     horizon = max(scenario.run_seconds, plan.end_time + 5.0)
     pool.run_for(horizon)
@@ -119,6 +259,12 @@ def run_scenario(scenario: "str | Scenario", seed: int,
 
     results = checker.check_all(
         probes=3, liveness_timeout=scenario.liveness_timeout)
+    # metrics snapshot BEFORE the proof-read closing check: the read
+    # service records a wall-clock qps gauge, which must not leak
+    # nondeterminism into the replayable report
+    metrics_summary = pool.metrics.summary()
+    catchup_block = _catchup_block(pool, plan, scenario, leech_floor)
+    results.extend(_catchup_verdicts(pool, plan, scenario, catchup_block))
 
     report = ChaosReport(
         scenario=scenario.name,
@@ -141,7 +287,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         invariants=[r.as_dict() for r in results],
         expected_failures=list(scenario.expect_fail),
         network=pool.network.counters(),
-        metrics=pool.metrics.summary(),
+        metrics=metrics_summary,
         ordered_per_node={nd.name: len(nd.ordered_digests)
                           for nd in pool.nodes},
         ordered_hash_per_node={
@@ -151,6 +297,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         monitor_per_node={
             nd.name: nd.monitor.snapshot() for nd in pool.nodes
             if getattr(nd, "monitor", None) is not None},
+        catchup=catchup_block,
         byzantine_nodes=sorted(plan.byzantine_nodes),
         periodic_checks=len(scheduler.probe_results),
         first_violation=scheduler.first_violation,

@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Tuple
 
 from .faults import (
     ClockSkewFault,
+    CorruptCatchupRepFault,
     CorruptOrderedLogFault,
     CrashFault,
     DelayFault,
@@ -31,6 +32,9 @@ from .faults import (
 )
 
 THREE_PC_TYPES = ("PrePrepare", "Prepare", "Commit")
+# the messages a seeder answers catchup with: silencing them models a
+# seeder that accepts requests and never replies (retry law territory)
+CATCHUP_REPLY_TYPES = ("CatchupRep", "ConsistencyProof", "LedgerStatus")
 
 
 @dataclass
@@ -48,6 +52,27 @@ class Scenario:
     liveness_timeout: float = 40.0
     expect_fail: Tuple[str, ...] = ()
     config_overrides: Dict = field(default_factory=dict)
+    # catchup-plane scenarios run REAL ledgers (the leecher needs them);
+    # bls additionally arms the state-proof plane so the freshly
+    # caught-up node can serve verify_proved_read-able replies
+    real_execution: bool = False
+    bls: bool = False
+    num_instances: int = 1  # RBFT protocol instances (0 = auto f+1)
+    # extra invariants the runner appends for catchup scenarios — each
+    # is ASSERTED from the pool's leecher meters, never assumed:
+    # require_catchup: every crashed-and-restarted node completed >= 1
+    #   leecher round, leeched > 0 txns, proof-verified every applied
+    #   batch, and is participating again;
+    # require_rejection: >= 1 CATCHUP_REP was rejected by audit-proof
+    #   verification (byzantine-seeder scenarios);
+    # require_retries: the retry law re-requested >= 1 silent slice;
+    # proof_read: the caught-up node serves a proof-attached read from
+    #   the window it just leeched that passes verify_proved_read
+    #   against the pool's BLS keys (needs bls=True).
+    require_catchup: bool = False
+    require_rejection: bool = False
+    require_retries: bool = False
+    proof_read: bool = False
 
     def plan(self, seed: int, n_nodes: int = 0) -> FaultPlan:
         n = n_nodes or self.n_nodes
@@ -234,6 +259,153 @@ register(Scenario(
     run_seconds=60.0,
     liveness_timeout=60.0,
     initial_requests=16))
+
+
+# --- catchup plane: recovery across checkpoint GC ------------------------
+#
+# The pre-catchup chaos library pinned CHK_FREQ high so a whole run fit
+# one checkpoint window (a node behind a stabilized checkpoint could not
+# recover). These scenarios do the opposite ON PURPOSE: tiny windows, a
+# crash long enough for >= StateProofCacheWindows checkpoints to
+# stabilize AND garbage-collect in the victim's absence, then a restart
+# — the victim must detect the gap (f+1 checkpoints beyond its H),
+# leech the missed range from seeders with every batch audit-proof
+# verified, and rejoin 3PC ordering.
+
+_CATCHUP_CONFIG = {
+    "Max3PCBatchSize": 1,  # checkpoints move per txn
+    "Max3PCBatchWait": 0.1,
+    "CHK_FREQ": 2,
+    "LOG_SIZE": 6,
+    # several small slices per ledger so round-robin assignment spreads
+    # requests across seeders (byzantine/silent seeders get their turn)
+    "CatchupBatchSize": 2,
+    # snappy, deterministic retry law under the mock clock
+    "ConsistencyProofsTimeout": 1.0,
+    "CatchupRequestTimeout": 1.5,
+    "CatchupMaxRetries": 8,
+    "OrderingStallTimeout": 4.0,
+    "StateProofCacheWindows": 2,
+}
+
+
+def _crash_across_gc(rng: random.Random, validators: List[str],
+                     at: float = 2.0, duration: float = 12.0) -> tuple:
+    """A non-primary victim crashed long enough for >= 2 checkpoint
+    windows to stabilize and GC without it (the trickle keeps batches —
+    and therefore checkpoints — flowing the whole time)."""
+    victim = rng.choice(validators[1:])
+    return victim, CrashFault(node=victim, at=at, duration=duration)
+
+
+def _f_crash_gc_catchup(rng: random.Random, validators: List[str]) -> List:
+    _, crash = _crash_across_gc(rng, validators)
+    return [crash]
+
+
+register(Scenario(
+    name="f_crash_gc_catchup",
+    build=_f_crash_gc_catchup,
+    description="node crashes, >= 2 checkpoint windows stabilize and GC "
+                "in its absence, restart -> full leecher round (every "
+                "batch audit-proof verified) -> rejoin; the caught-up "
+                "node then serves a verify_proved_read-able reply",
+    run_seconds=30.0,
+    liveness_timeout=45.0,
+    real_execution=True,
+    bls=True,
+    require_catchup=True,
+    proof_read=True,
+    config_overrides=dict(_CATCHUP_CONFIG)))
+
+
+def _byzantine_seeder_catchup(rng: random.Random,
+                              validators: List[str]) -> List:
+    victim, crash = _crash_across_gc(rng, validators)
+    # a byzantine seeder among the survivors: corrupted CATCHUP_REPs must
+    # be rejected by proof verification, never trusted (it stays honest
+    # in 3PC — only its catchup answers lie)
+    evil = rng.choice([v for v in validators if v != victim])
+    return [CorruptCatchupRepFault(node=evil, at=0.0), crash]
+
+
+register(Scenario(
+    name="byzantine_seeder_catchup",
+    build=_byzantine_seeder_catchup,
+    description="GC-crossing crash/restart while a byzantine seeder "
+                "serves corrupted CATCHUP_REPs: proof verification must "
+                "reject them (asserted) and honest seeders complete the "
+                "round",
+    run_seconds=30.0,
+    liveness_timeout=45.0,
+    real_execution=True,
+    require_catchup=True,
+    require_rejection=True,
+    config_overrides=dict(_CATCHUP_CONFIG)))
+
+
+def _silent_seeder_catchup(rng: random.Random,
+                           validators: List[str]) -> List:
+    victim, crash = _crash_across_gc(rng, validators)
+    # one survivor answers NOTHING on the catchup plane while the victim
+    # recovers: the seeded retry/timeout/backoff law must re-route its
+    # slices to the live seeders instead of stalling
+    mute = rng.choice([v for v in validators if v != victim])
+    return [crash,
+            SilenceFault(node=mute, types=CATCHUP_REPLY_TYPES,
+                         at=13.0, duration=22.0)]
+
+
+register(Scenario(
+    name="silent_seeder_catchup",
+    build=_silent_seeder_catchup,
+    description="GC-crossing crash/restart with one seeder silent on the "
+                "whole catchup plane: the retry law re-routes its slices "
+                "(retries asserted) and recovery completes",
+    run_seconds=40.0,
+    liveness_timeout=45.0,
+    real_execution=True,
+    require_catchup=True,
+    require_retries=True,
+    config_overrides=dict(_CATCHUP_CONFIG)))
+
+
+def _ic_storm_mid_catchup(rng: random.Random,
+                          validators: List[str]) -> List:
+    victim, crash = _crash_across_gc(rng, validators)
+    # monitor-degradation storm mid-catchup: a byzantine backup-instance
+    # primary withholds its PRE-PREPAREs for the whole recovery window
+    # AND the master primary goes silent long enough for the ordering
+    # stall watchdog to force an instance change while the victim is
+    # still leeching — catchup must survive the view change. Under the
+    # round-robin selector the instance-1 primary is validators[1] (the
+    # victim is drawn from validators[1:], so skip to validators[2] when
+    # they collide); the view-0 master primary is validators[0], which
+    # is never the victim.
+    backup_primary = validators[1] if validators[1] != victim \
+        else validators[2]
+    return [
+        crash,
+        SilenceFault(node=backup_primary, types=("PrePrepare",),
+                     at=14.0, duration=8.0),
+        SilenceFault(node=validators[0], types=("PrePrepare",),
+                     at=15.0, duration=6.0),
+    ]
+
+
+register(Scenario(
+    name="ic_storm_mid_catchup",
+    build=_ic_storm_mid_catchup,
+    description="GC-crossing crash/restart with a byzantine backup "
+                "primary and a stalled master mid-catchup: the instance "
+                "change fires while the victim is leeching and recovery "
+                "still completes",
+    run_seconds=45.0,
+    liveness_timeout=60.0,
+    real_execution=True,
+    num_instances=0,  # auto f+1: real RBFT backup instances in the storm
+    require_catchup=True,
+    config_overrides=dict(_CATCHUP_CONFIG)))
 
 
 # --- the checker-vacuity proof -------------------------------------------

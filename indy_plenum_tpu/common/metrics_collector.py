@@ -95,8 +95,16 @@ class MetricsName:
     GOVERNOR_SHARD_OCCUPANCY_EWMA = "governor.shard_occupancy_ewma"
     # execution
     COMMIT_TIME = "exec.commit_time"
-    # catchup
+    # catchup (chaos-hardened recovery plane): rounds completed, txns
+    # fetched+applied, audit-proof verifications the leecher performed
+    # on leeched batches (and the txns it REJECTED for failing them —
+    # byzantine seeders), and re-requests the retry law issued
     CATCHUP_FAILED = "catchup.failed"
+    CATCHUP_ROUNDS = "catchup.rounds"
+    CATCHUP_TXNS_LEECHED = "catchup.txns_leeched"
+    CATCHUP_PROOFS_VERIFIED = "catchup.proofs_verified"
+    CATCHUP_REPS_REJECTED = "catchup.reps_rejected"
+    CATCHUP_RETRIES = "catchup.retries"
     # transport
     ZSTACK_DROPPED = "zstack.dropped"
     # simulation network / chaos plane

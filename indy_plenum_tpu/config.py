@@ -61,9 +61,27 @@ class Config:
     CatchupTransactionsTimeout: float = 6.0
     ConsistencyProofsTimeout: float = 5.0
     CatchupBatchSize: int = 5000  # txns per CATCHUP_REQ slice
+    # Per-slice leecher retry law (server/catchup/retry.py): an unanswered
+    # CATCHUP_REQ slice is re-assigned to another peer after
+    # CatchupRequestTimeout (0 = fall back to CatchupTransactionsTimeout,
+    # the pre-retry-law knob), each further silence backs the slice's
+    # deadline off multiplicatively (CatchupRetryBackoffMult) with seeded
+    # jitter (CatchupRetryJitterFrac of the delay, derived from
+    # CatchupRetryJitterSeed | slice | attempt — deterministic, so seeded
+    # sim runs replay identical retry schedules), and after
+    # CatchupMaxRetries exhausted slices FAIL the round closed (the
+    # leecher's CatchupFailedRetryBackoff path) instead of re-asking
+    # forever — a silent seeder pool can delay recovery, never stall it.
+    CatchupRequestTimeout: float = 0.0
+    CatchupMaxRetries: int = 10
+    CatchupRetryBackoffMult: float = 1.5
+    CatchupRetryBackoffMax: float = 60.0
+    CatchupRetryJitterFrac: float = 0.25
+    CatchupRetryJitterSeed: int = 0
     # fail-closed retry: a node whose catchup FAILED (history convicted as
-    # diverged but no honest quorum reachable) stays non-participating and
-    # retries with exponential backoff between these bounds
+    # diverged but no honest quorum reachable, or a slice exhausted its
+    # retry budget) stays non-participating and retries with exponential
+    # backoff between these bounds
     CatchupFailedRetryBackoff: float = 10.0
     CatchupFailedRetryBackoffMax: float = 300.0
 

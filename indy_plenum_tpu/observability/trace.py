@@ -74,6 +74,11 @@ AUTH_PHASE = ("auth", "req.ingress", "req.finalised")
 # boundary batch's (view, pp_seq), so the sample measures exactly the
 # stabilization wait a proved read pays before a root is servable.
 PROOF_PHASE = ("proof", "3pc.ordered", "proof.window_signed")
+# catchup plane: a leecher round's full recovery arc, joined per
+# (node, round ordinal) — how long a lagging node took from detecting
+# the gap to rejoining 3PC with every leeched batch proof-verified
+# (``catchup.txns_leeched`` marks ride the same category, un-keyed).
+CATCHUP_PHASE = ("catchup", "catchup.started", "catchup.completed")
 
 
 class TraceRecorder:
@@ -336,6 +341,15 @@ def phase_durations(events: List[Dict[str, Any]],
             (ev.get("node", ""), ev["key"][0], ev["key"][1]))
         if t0 is not None:
             out.setdefault(PROOF_PHASE[0], []).append(ev["ts"] - t0)
+    # catchup phase: each leecher round's started -> completed arc,
+    # joined per (node, round ordinal) like the 3PC lifecycle marks
+    for (_node, _key), marks in sorted(
+            _mark_times(events, "catchup",
+                        None if node is None
+                        else frozenset((node,))).items()):
+        if CATCHUP_PHASE[1] in marks and CATCHUP_PHASE[2] in marks:
+            out.setdefault(CATCHUP_PHASE[0], []).append(
+                marks[CATCHUP_PHASE[2]] - marks[CATCHUP_PHASE[1]])
     return out
 
 

@@ -9,12 +9,14 @@ device audit-path kernel (tpu/sha256.py).
 from .catchup_rep_service import CatchupRepService, verify_audit_paths_batch
 from .cons_proof_service import ConsProofService
 from .node_leecher_service import NodeLeecherService
+from .retry import RetryLaw
 from .seeder_service import SeederService
 
 __all__ = [
     "CatchupRepService",
     "ConsProofService",
     "NodeLeecherService",
+    "RetryLaw",
     "SeederService",
     "verify_audit_paths_batch",
 ]

@@ -25,6 +25,7 @@ import numpy as np
 
 from ...common.event_bus import ExternalBus
 from ...common.messages.node_messages import CatchupRep, CatchupReq
+from ...common.metrics_collector import MetricsName
 from ...common.timer import RepeatingTimer, TimerService
 from ...ledger.merkle_verifier import STH, MerkleVerifier
 from ...utils.base58 import b58decode
@@ -340,8 +341,14 @@ class CatchupRepService:
                  db,
                  config=None,
                  suspicion_sink=None,
-                 apply_txn: Optional[Callable[[dict], None]] = None):
+                 apply_txn: Optional[Callable[[dict], None]] = None,
+                 metrics=None,
+                 trace=None,
+                 node: str = ""):
+        from ...common.metrics_collector import NullMetricsCollector
         from ...config import getConfig
+        from ...observability.trace import NULL_TRACE
+        from .retry import RetryLaw
 
         self._ledger_id = ledger_id
         self._network = network
@@ -351,13 +358,22 @@ class CatchupRepService:
         self._suspicion = suspicion_sink or (lambda ex: None)
         # called per applied txn (state updates on stateful ledgers)
         self._apply_txn = apply_txn
+        self._metrics = metrics if metrics is not None \
+            else NullMetricsCollector()
+        self._trace = trace if trace is not None else NULL_TRACE
+        self._node = node
 
         self._running = False
         self._on_done: Optional[Callable[[], None]] = None
+        self._on_fail: Optional[Callable[[], None]] = None
         self._target_size = 0
         self._target_root = b""
         # slice start -> (end, assigned peer)
         self._outstanding: Dict[int, Tuple[int, str]] = {}
+        # retry law bookkeeping: slice start -> sends so far / deadline
+        # after which the slice is re-assigned (seeded, deterministic)
+        self._attempts: Dict[int, int] = {}
+        self._due: Dict[int, float] = {}
         # verified-but-early reps: start seq -> ordered txns
         self._ready: Dict[int, List[dict]] = {}
         # ONE in-flight async device verification (sender, start, end,
@@ -366,9 +382,19 @@ class CatchupRepService:
         # overlaps network wait + host packing of the next slice
         self._inflight: Optional[tuple] = None
         self._peer_rr: List[str] = []
+        self._law = RetryLaw.from_config(self._config)
+        # the poll runs at half the base timeout so backoff deadlines
+        # resolve within one poll step; re-asks fire only when a slice's
+        # seeded deadline has actually passed
         self._retry = RepeatingTimer(
-            timer, self._config.CatchupTransactionsTimeout,
-            self._rerequest_outstanding, active=False)
+            timer, max(self._law.base / 2.0, 0.01),
+            self._service_retries, active=False)
+        # lifetime meters (observability: Monitor catchup block, chaos
+        # report catchup block, the bench's verified-proofs/sec)
+        self.txns_leeched = 0
+        self.proofs_verified = 0
+        self.reps_rejected = 0
+        self.retries = 0
 
         network.subscribe(CatchupRep, self.process_catchup_rep)
 
@@ -379,12 +405,19 @@ class CatchupRepService:
         return self._db.get_ledger(self._ledger_id)
 
     def start(self, target_size: int, target_root: bytes,
-              on_done: Callable[[], None]) -> None:
+              on_done: Callable[[], None],
+              on_fail: Optional[Callable[[], None]] = None) -> None:
+        """``on_fail`` fires when a slice exhausts ``CatchupMaxRetries``
+        re-assignments: the round FAILS CLOSED (the leecher's backoff
+        path owns the next attempt) instead of re-asking forever."""
         ledger = self._ledger
         self._target_size = target_size
         self._target_root = target_root
         self._on_done = on_done
+        self._on_fail = on_fail
         self._outstanding.clear()
+        self._attempts.clear()
+        self._due.clear()
         self._ready.clear()
         self._running = True
         if ledger.size >= target_size:
@@ -402,6 +435,20 @@ class CatchupRepService:
         self._inflight = None
         self._retry.stop()
 
+    def _send_slice(self, start: int, end: int, peer: str) -> None:
+        """One slice to one peer, with its retry-law deadline armed."""
+        attempt = self._attempts.get(start, 0) + 1
+        self._attempts[start] = attempt
+        self._due[start] = self._timer.get_current_time() \
+            + self._law.delay((self._ledger_id, start), attempt)
+        self._outstanding[start] = (end, peer)
+        self._network.send(CatchupReq(
+            ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
+            catchupTill=self._target_size), [peer])
+        if attempt > 1:
+            self.retries += 1
+            self._metrics.add_event(MetricsName.CATCHUP_RETRIES)
+
     def _send_requests(self, frm: int, to: int) -> None:
         if not self._peer_rr:
             return
@@ -411,29 +458,52 @@ class CatchupRepService:
             end = min(start + batch - 1, to)
             peer = self._peer_rr[i % len(self._peer_rr)]
             i += 1
-            self._outstanding[start] = (end, peer)
-            self._network.send(CatchupReq(
-                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
-                catchupTill=self._target_size), [peer])
+            self._send_slice(start, end, peer)
 
-    def _rerequest_outstanding(self) -> None:
-        """Reassign every still-unanswered slice to the next peer."""
+    def _give_up(self) -> None:
+        """A slice ran out of retry budget: fail the whole round closed.
+        Re-asking forever would leave the node non-participating but
+        "recovering" indefinitely; the leecher's failed-catchup backoff
+        owns when to try the pool again."""
+        logger.error(
+            "catchup ledger %d: slice exhausted %d retries; failing the "
+            "round (leecher backoff takes over)", self._ledger_id,
+            self._law.max_retries)
+        cb = self._on_fail
+        self.stop()
+        self._on_done = None
+        self._on_fail = None
+        if cb is not None:
+            cb()
+
+    def _service_retries(self) -> None:
+        """Re-assign every slice whose seeded retry deadline has passed
+        to the next peer; exhaust the budget => fail the round closed."""
         self._resolve_inflight()
         if not self._running or not self._outstanding:
+            return
+        now = self._timer.get_current_time()
+        due = [start for start in self._outstanding
+               if now >= self._due.get(start, 0.0)]
+        if not due:
             return
         self._peer_rr = sorted(self._network.connecteds)
         if not self._peer_rr:
             return
-        for start, (end, old_peer) in list(self._outstanding.items()):
+        for start in due:
+            if start not in self._outstanding:
+                continue  # an earlier give-up stopped the round
+            if self._law.exhausted(self._attempts.get(start, 0)):
+                self._give_up()
+                return
+            end, old_peer = self._outstanding[start]
             others = [p for p in self._peer_rr if p != old_peer] \
                 or self._peer_rr
             peer = others[start % len(others)]
-            self._outstanding[start] = (end, peer)
-            self._network.send(CatchupReq(
-                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
-                catchupTill=self._target_size), [peer])
-            logger.info("catchup ledger %d: re-requesting %d..%d from %s",
-                        self._ledger_id, start, end, peer)
+            self._send_slice(start, end, peer)
+            logger.info("catchup ledger %d: re-requesting %d..%d from %s "
+                        "(attempt %d)", self._ledger_id, start, end, peer,
+                        self._attempts[start])
 
     # ------------------------------------------------------------------
 
@@ -509,34 +579,40 @@ class CatchupRepService:
                 self._ledger_id, int((~ok).sum()), len(ok), sender)
             self._bad_rep(sender, start)
             return
+        self.proofs_verified += len(ok)
+        self._metrics.add_event(MetricsName.CATCHUP_PROOFS_VERIFIED,
+                                len(ok))
         del self._outstanding[start]
+        self._due.pop(start, None)
         self._ready[start] = [txns[str(s)] for s in seqs]
         if seqs[-1] < end:
-            # short (clamped) rep: re-request the tail
+            # short (clamped) rep: re-request the tail (a fresh slice —
+            # its retry budget starts from scratch)
             peer = self._peer_rr[seqs[-1] % len(self._peer_rr)] \
                 if self._peer_rr else sender
-            self._outstanding[seqs[-1] + 1] = (end, peer)
-            self._network.send(CatchupReq(
-                ledgerId=self._ledger_id, seqNoStart=seqs[-1] + 1,
-                seqNoEnd=end, catchupTill=self._target_size), [peer])
+            self._send_slice(seqs[-1] + 1, end, peer)
         self._apply_ready()
 
     def _bad_rep(self, sender: str, start: int) -> None:
         from ...common.exceptions import SuspiciousNode
 
+        self.reps_rejected += 1
+        self._metrics.add_event(MetricsName.CATCHUP_REPS_REJECTED)
         self._suspicion(SuspiciousNode(sender, Suspicions.CATCHUP_REP_WRONG))
-        # reassign this slice to someone else immediately
+        # reassign this slice to someone else immediately; a byzantine
+        # seeder's rejected reps consume the slice's retry budget too (it
+        # must not be able to bounce a slice around forever)
         end, _ = self._outstanding[start]
+        if self._law.exhausted(self._attempts.get(start, 0)):
+            self._give_up()
+            return
         others = [p for p in self._peer_rr if p != sender] or self._peer_rr
         if others:
-            peer = others[start % len(others)]
-            self._outstanding[start] = (end, peer)
-            self._network.send(CatchupReq(
-                ledgerId=self._ledger_id, seqNoStart=start, seqNoEnd=end,
-                catchupTill=self._target_size), [peer])
+            self._send_slice(start, end, others[start % len(others)])
 
     def _apply_ready(self) -> None:
         ledger = self._ledger
+        applied = 0
         while True:
             nxt = ledger.size + 1
             txns = self._ready.pop(nxt, None)
@@ -546,6 +622,16 @@ class CatchupRepService:
                 ledger.add(txn)
                 if self._apply_txn is not None:
                     self._apply_txn(txn)
+            applied += len(txns)
+        if applied:
+            self.txns_leeched += applied
+            self._metrics.add_event(MetricsName.CATCHUP_TXNS_LEECHED,
+                                    applied)
+            if self._trace.enabled:
+                self._trace.record(
+                    "catchup.txns_leeched", cat="catchup", node=self._node,
+                    args={"ledger": self._ledger_id, "txns": applied,
+                          "size": ledger.size})
         if ledger.size >= self._target_size:
             self._finish()
 
@@ -553,6 +639,7 @@ class CatchupRepService:
         self.stop()
         cb = self._on_done
         self._on_done = None
+        self._on_fail = None
         logger.info("catchup ledger %d complete at size %d", self._ledger_id,
                     self._ledger.size)
         if cb is not None:

@@ -65,11 +65,13 @@ class NodeLeecherService:
                  bootstrap,
                  config=None,
                  suspicion_sink=None,
-                 metrics=None):
+                 metrics=None,
+                 trace=None):
         """``bootstrap`` is the node's LedgersBootstrap (ledgers, states,
         write manager, state-rebuild)."""
         from ...common.metrics_collector import NullMetricsCollector
         from ...config import getConfig
+        from ...observability.trace import NULL_TRACE
 
         self._data = data
         self._bus = bus
@@ -80,12 +82,14 @@ class NodeLeecherService:
         self._suspicion = suspicion_sink or (lambda ex: None)
         self._metrics = metrics if metrics is not None \
             else NullMetricsCollector()
+        self._trace = trace if trace is not None else NULL_TRACE
 
         self._running = False
         self._audit_attempts = 0
         self._remaining: List[int] = []
         self.catchups_completed = 0  # observability / tests
         self.catchups_failed = 0  # consecutive failures (backoff exponent)
+        self.rounds_started = 0  # every start(), completed or not
 
         self._cons_proof = ConsProofService(
             AUDIT_LEDGER_ID, network, timer, self._boot.db,
@@ -94,7 +98,8 @@ class NodeLeecherService:
         self._rep_services = {
             lid: CatchupRepService(
                 lid, network, timer, self._boot.db, config=self._config,
-                suspicion_sink=self._suspicion)
+                suspicion_sink=self._suspicion, metrics=self._metrics,
+                trace=self._trace, node=self._data.name)
             for lid in (AUDIT_LEDGER_ID,) + LEDGER_ORDER}
         # divergence recovery: find the fork point and refetch a SUFFIX
         # instead of nuking the whole ledger (r3 verdict weakness 7)
@@ -112,7 +117,16 @@ class NodeLeecherService:
     # ------------------------------------------------------------------
 
     def _on_need_catchup(self, msg: NeedMasterCatchup, *args) -> None:
-        self.start()
+        # DEFERRED start: NeedMasterCatchup can fire in the middle of an
+        # Ordered dispatch (the checkpoint service sees the boundary batch
+        # before the executor commits it) — starting synchronously would
+        # revert a staged batch that is EN ROUTE to commit in the same
+        # bus dispatch, and the commit then pops an empty staged list.
+        # One 0-delay timer hop lands the start after the current event
+        # completes; same virtual instant, so seeded runs stay
+        # deterministic, and start() is idempotent under a burst of
+        # triggers.
+        self._timer.schedule(0.0, self.start)
 
     def _retry_after_failure(self) -> None:
         # only act if the node is still in the failed state: a catchup
@@ -127,7 +141,15 @@ class NodeLeecherService:
         if self._running:
             return
         self._running = True
+        self.rounds_started += 1
         logger.info("%s starting catchup", self._data.name)
+        if self._trace.enabled:
+            # leecher rounds are trace spans: started -> txns_leeched* ->
+            # completed, keyed by the round ordinal so the phase-latency
+            # machinery can join start/end per (node, round)
+            self._trace.record("catchup.started", cat="catchup",
+                               node=self._data.name,
+                               key=(self.rounds_started,))
         self._data.is_participating = False
         # uncommitted 3PC work is void — catchup writes committed txns and
         # Ledger.add() requires nothing staged
@@ -173,7 +195,8 @@ class NodeLeecherService:
             else:
                 audit.reset_to(0)  # ahead AND diverged below the target
         self._rep_services[AUDIT_LEDGER_ID].start(
-            size, self._audit_target[1], self._on_audit_fetched)
+            size, self._audit_target[1], self._on_audit_fetched,
+            on_fail=self._on_round_failed)
 
     def _restart_audit_phase(self) -> None:
         self._audit_attempts += 1
@@ -243,7 +266,8 @@ class NodeLeecherService:
                 continue
             self._current_lid = lid
             self._current_target = (size, root)
-            self._rep_services[lid].start(size, root, self._on_ledger_fetched)
+            self._rep_services[lid].start(size, root, self._on_ledger_fetched,
+                                          on_fail=self._on_round_failed)
             return
         self._finish()
 
@@ -255,7 +279,8 @@ class NodeLeecherService:
             logger.warning("%s: ledger %d root mismatch after fetch; "
                            "resyncing from scratch", self._data.name, lid)
             ledger.reset_to(0)
-            self._rep_services[lid].start(size, root, self._on_ledger_fetched)
+            self._rep_services[lid].start(size, root, self._on_ledger_fetched,
+                                          on_fail=self._on_round_failed)
             return
         self._next_ledger()
 
@@ -263,8 +288,36 @@ class NodeLeecherService:
     # phase 3: states + consensus resync
     # ------------------------------------------------------------------
 
+    def _on_round_failed(self) -> None:
+        """A ledger fetch exhausted its retry budget (every reachable
+        seeder silent or byzantine): fail the round closed."""
+        self._finish(failed=True)
+
+    def catchup_stats(self):
+        """Aggregate leecher meters (Monitor catchup block, chaos report
+        catchup block, bench): rounds + what the rep services counted."""
+        reps = list(self._rep_services.values())
+        return {
+            "rounds_started": self.rounds_started,
+            "rounds_completed": self.catchups_completed,
+            "rounds_failed_consecutive": self.catchups_failed,
+            "txns_leeched": sum(r.txns_leeched for r in reps),
+            "proofs_verified": sum(r.proofs_verified for r in reps),
+            "reps_rejected": sum(r.reps_rejected for r in reps),
+            "retries": sum(r.retries for r in reps),
+        }
+
     def _finish(self, failed: bool = False) -> None:
         self._running = False
+        if self._trace.enabled:
+            stats = self.catchup_stats()
+            self._trace.record(
+                "catchup.completed" if not failed else "catchup.failed",
+                cat="catchup", node=self._data.name,
+                key=(self.rounds_started,),
+                args={"txns_leeched": stats["txns_leeched"],
+                      "proofs_verified": stats["proofs_verified"],
+                      "retries": stats["retries"]})
         if failed:
             # FAIL CLOSED (reference: a node stays in Mode.syncing, never
             # participating, until caught up): our history was convicted as
@@ -305,6 +358,7 @@ class NodeLeecherService:
             self._data.view_no = view_no
         self._data.is_participating = True
         self.catchups_completed += 1
+        self._metrics.add_event(MetricsName.CATCHUP_ROUNDS)
         logger.info("%s catchup complete: 3pc=(%d,%d)", self._data.name,
                     view_no, pp_seq_no)
         self._bus.send(CatchupFinished(

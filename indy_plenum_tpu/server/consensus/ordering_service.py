@@ -1044,9 +1044,6 @@ class OrderingService:
         self._data.stable_checkpoint = max(self._data.stable_checkpoint,
                                            pp_seq_no)
         self._data.free_upto(pp_seq_no)
-        if self._executor is not None:
-            self._last_applied_seq = max(self._last_applied_seq,
-                                         self._executor.committed_seq())
         for store in (self.sent_preprepares, self.prePrepares,
                       self.prepares, self.commits, self.batches):
             for key in [k for k in store if k[1] <= pp_seq_no]:
@@ -1054,6 +1051,25 @@ class OrderingService:
         self.ordered = {k for k in self.ordered if k[1] > pp_seq_no}
         self._commit_quorum_marked = {
             k for k in self._commit_quorum_marked if k[1] > pp_seq_no}
+        if self._executor is not None:
+            # the leecher reverted EVERY staged speculative apply before
+            # fetching (catchup writes committed txns): nothing above the
+            # durable floor is applied anymore, whatever the pre-catchup
+            # bookkeeping said — a stale higher floor would let a
+            # retained batch order against a staged list that is empty
+            self._last_applied_seq = self._executor.committed_seq()
+        # retained PRE-PREPAREs above the caught-up point were applied
+        # BEFORE the leecher's revert (a mid-stream second catchup hits
+        # this): their staged batches are gone, so ordering them now
+        # would commit nothing. Drop the PP records (their PREPARE/COMMIT
+        # votes stay — the stall watchdog's in-flight re-request sees
+        # those keys, re-fetches each PP from the primary, and the normal
+        # processing path re-APPLIES it under the in-order discipline).
+        dropped = [k for k in self.prePrepares if k not in self.ordered]
+        for key in dropped:
+            pp = self.prePrepares.pop(key)
+            self.batches.pop(key, None)
+            self._data.free_batch(preprepare_to_batch_id(pp))
         if self._vote_plane is not None:
             self._vote_plane.reset(h=pp_seq_no)
         self._bls.gc((view_no, pp_seq_no))

@@ -253,6 +253,24 @@ class Monitor:
                     proofs[label] = int(stat.total)
             if proofs:
                 snap["proofs"] = proofs
+            # catchup plane (chaos-hardened recovery): leecher rounds
+            # completed, txns fetched+applied, audit proofs verified on
+            # leeched batches, byzantine reps rejected, and retry-law
+            # re-requests — absent entirely when the node never leeched
+            catchup = {}
+            for label, name in (
+                    ("rounds", MetricsName.CATCHUP_ROUNDS),
+                    ("txns_leeched", MetricsName.CATCHUP_TXNS_LEECHED),
+                    ("proofs_verified",
+                     MetricsName.CATCHUP_PROOFS_VERIFIED),
+                    ("reps_rejected", MetricsName.CATCHUP_REPS_REJECTED),
+                    ("retries", MetricsName.CATCHUP_RETRIES),
+                    ("failed", MetricsName.CATCHUP_FAILED)):
+                stat = self._metrics.stat(name)
+                if stat is not None:
+                    catchup[label] = int(stat.total)
+            if catchup:
+                snap["catchup"] = catchup
         if self._trace is not None and self._trace.enabled:
             # per-phase latency attribution (flight recorder): where this
             # node's ordered batches spent their time — prepare / commit

@@ -357,7 +357,7 @@ class Node:
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, timer=timer, bootstrap=self.boot,
             config=self.config, suspicion_sink=catchup_suspicion,
-            metrics=self.metrics)
+            metrics=self.metrics, trace=self.trace)
 
         # --- RBFT: monitor + backup instances ----------------------------
         from ..common.messages.internal_messages import (

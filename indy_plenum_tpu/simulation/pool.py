@@ -303,7 +303,8 @@ class SimNode:
             self.leecher = NodeLeecherService(
                 data=self.data, bus=self.internal_bus,
                 network=self.external_bus, timer=timer, bootstrap=self.boot,
-                config=config, suspicion_sink=catchup_suspicion)
+                config=config, suspicion_sink=catchup_suspicion,
+                metrics=metrics, trace=self.trace)
 
         # execution: commit batches as they order (the Node's job);
         # re-ordered duplicates after a view change are skipped by seqNo
@@ -358,6 +359,21 @@ class SimNode:
         for o in self.ordered_log:
             out.extend(o.reqIdr)
         return out
+
+    @property
+    def committed_request_digests(self) -> List[str]:
+        """The committed domain ledger's request-digest sequence — the
+        ordering fingerprint that COVERS catchup: a node that leeched a
+        range never saw its ``Ordered`` events, but the fetched txns
+        carry the original request digests in their metadata, so the
+        ledger sequence is bit-comparable across survivors and
+        freshly-caught-up nodes. Requires real execution."""
+        from ..common.constants import DOMAIN_LEDGER_ID
+        from ..common.txn_util import get_digest
+
+        ledger = self.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+        return [get_digest(ledger.get_by_seq_no(s)) or ""
+                for s in range(1, ledger.size + 1)]
 
 
 class SimPool:
@@ -756,3 +772,13 @@ class SimPool:
         check_dispatch_budget's sharded gate compare runs on it."""
         return hashlib.sha256(
             "|".join(self.nodes[0].ordered_digests).encode()).hexdigest()
+
+    def ledger_hash(self, name: str) -> str:
+        """sha256 of ``name``'s committed domain-ledger request-digest
+        sequence (real execution only) — the per-node ordering
+        fingerprint that stays comparable ACROSS CATCHUP: a node that
+        leeched a GC'd range has the identical ledger sequence as the
+        survivors even though its ``ordered_log`` skips the leeched
+        middle. The catchup gate asserts bit-identity on this."""
+        return hashlib.sha256("|".join(
+            self.node(name).committed_request_digests).encode()).hexdigest()

@@ -104,8 +104,19 @@ def main() -> int:
     if args.list:
         for name in sorted(SCENARIOS):
             sc = SCENARIOS[name]
-            tag = (" [expects FAIL: " + ", ".join(sc.expect_fail) + "]"
-                   if sc.expect_fail else "")
+            tags = []
+            if sc.expect_fail:
+                tags.append("expects FAIL: " + ", ".join(sc.expect_fail))
+            if sc.real_execution:
+                extra = [flag for flag, on in (
+                    ("catchup", sc.require_catchup),
+                    ("byz-seeder-rejection", sc.require_rejection),
+                    ("retry-law", sc.require_retries),
+                    ("proof-read", sc.proof_read)) if on]
+                tags.append("real-exec" + ("+bls" if sc.bls else "")
+                            + ("; asserts " + ", ".join(extra)
+                               if extra else ""))
+            tag = "".join(f" [{t}]" for t in tags)
             print(f"{name:24s} {sc.description}{tag}")
         return 0
 

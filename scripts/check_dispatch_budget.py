@@ -55,6 +55,17 @@ dict lookup), if any reply fails client-side end-to-end verification
 batched multi-sig verifier falls below 2x the per-root path at batch 64
 (the whole point of batching pairings across roots/windows).
 
+Catchup gate (PR 11): unless ``--no-catchup-gate``, the script runs the
+seeded GC-crossing crash/restart chaos scenario (a node crashes, >= 2
+checkpoint windows stabilize and garbage-collect in its absence, it
+restarts and leeches the gap back) and fails if any chaos verdict fails,
+if the caught-up node's committed-ledger hash is not bit-identical to
+the survivors', if the run does not replay byte-identically
+(``trace_hash``) from its seed, if the freshly-caught-up node's
+proof-attached read fails ``verify_proved_read``, or if the
+byzantine-seeder scenario's corrupted CATCHUP_REPs were not rejected by
+proof verification. Catchup throughput is recorded in the gate output.
+
 Fabric gate (PR 9): unless ``--no-fabric-gate``, the script runs the
 n=16/k=6 workload on the 2-axis member x validator fabric (half the
 sharded gate's devices on each axis) and compares it against the 1-axis
@@ -691,6 +702,81 @@ def proof_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def catchup_gate(args) -> "tuple[dict, list]":
+    """Chaos-hardened catchup gate: (1) the seeded GC-crossing
+    crash/restart scenario (``f_crash_gc_catchup``: crash, >= 2
+    checkpoint windows stabilize AND garbage-collect in the victim's
+    absence, restart, full leecher round) must PASS every verdict —
+    including ``catchup_recovery`` (each leeched batch audit-proof
+    verified, victim participating again) and ``catchup_proof_read``
+    (the caught-up node serves a ``verify_proved_read``-able reply from
+    the window it just leeched); (2) the caught-up node's
+    committed-ledger hash must be bit-identical to every survivor's;
+    (3) the run must replay byte-identically (``trace_hash``) from its
+    seed; (4) the byzantine-seeder scenario must REJECT corrupted
+    CATCHUP_REPs by proof verification (asserted, not assumed) and
+    still recover through honest seeders. Catchup throughput lands in
+    the gate record."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    t0 = time.perf_counter()
+    first = run_scenario("f_crash_gc_catchup", seed=args.seed, trace=True)
+    gc_wall = time.perf_counter() - t0
+    replay = run_scenario("f_crash_gc_catchup", seed=args.seed, trace=True)
+    byz = run_scenario("byzantine_seeder_catchup", seed=args.seed)
+
+    failures = []
+    if not first.verdict_as_expected:
+        failures.append(
+            f"f_crash_gc_catchup verdicts: failed={first.failed} "
+            f"expected={first.expected_failures}")
+    hashes = first.catchup.get("ledger_hash_per_node", {})
+    if len(set(hashes.values())) != 1:
+        failures.append(
+            "caught-up node's committed ledger diverges from the "
+            f"survivors: {hashes}")
+    if replay.trace_hash != first.trace_hash:
+        failures.append(
+            "catchup-bearing run does not replay byte-identically "
+            f"(trace_hash {first.trace_hash[:12]} vs "
+            f"{replay.trace_hash[:12]})")
+    if not first.catchup.get("proof_read", {}).get("verified"):
+        failures.append("caught-up node's proof-attached read failed "
+                        "verify_proved_read")
+    if not byz.verdict_as_expected:
+        failures.append(
+            f"byzantine_seeder_catchup verdicts: failed={byz.failed}")
+    if byz.catchup.get("reps_rejected", 0) < 1:
+        failures.append("byzantine seeder's corrupted CATCHUP_REPs were "
+                        "never rejected (the corruption was trusted or "
+                        "never exercised)")
+    record = {
+        "scenario": "f_crash_gc_catchup",
+        "seed": args.seed,
+        "verdicts_pass": first.verdict_as_expected,
+        "txns_leeched": first.catchup.get("txns_leeched"),
+        "proofs_verified": first.catchup.get("proofs_verified"),
+        "retries": first.catchup.get("retries"),
+        "ledger_hashes_match": len(set(hashes.values())) == 1,
+        "proof_read": first.catchup.get("proof_read"),
+        "trace_hash": first.trace_hash,
+        "replay_identical": replay.trace_hash == first.trace_hash,
+        "wall_s": round(gc_wall, 2),
+        # recovery throughput: what the whole seeded arc (detect the
+        # gap, agree a target, fetch, device-verify, rejoin) sustained
+        "leeched_txns_per_wall_sec": round(
+            (first.catchup.get("txns_leeched") or 0) / gc_wall, 1)
+        if gc_wall else None,
+        "byzantine_seeder": {
+            "verdicts_pass": byz.verdict_as_expected,
+            "reps_rejected": byz.catchup.get("reps_rejected"),
+            "retries": byz.catchup.get("retries"),
+        },
+        "replay_command": first.replay_command,
+    }
+    return record, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -721,6 +807,11 @@ def main() -> int:
                     help="skip the state-proof plane gate (ordered-hash "
                          "identity, zero serve-path pairings, client "
                          "verify, batched-verify speedup)")
+    ap.add_argument("--no-catchup-gate", action="store_true",
+                    help="skip the chaos-hardened catchup gate "
+                         "(GC-crossing crash/restart verdicts, ledger "
+                         "bit-identity, byte-identical replay, byzantine "
+                         "seeder rejection)")
     ap.add_argument("--proof-speedup-floor", type=float, default=2.0,
                     help="min batch-64 multi-sig verify speedup vs the "
                          "per-root path")
@@ -819,6 +910,10 @@ def main() -> int:
     if not args.no_proof_gate:
         record, failures = proof_gate(args)
         result["proof_gate"] = record
+        over.extend(failures)
+    if not args.no_catchup_gate:
+        record, failures = catchup_gate(args)
+        result["catchup_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

@@ -477,6 +477,326 @@ def test_probe_statuses_are_never_status_evidence():
     assert not fork._tip_votes and not found
 
 
+def test_retry_law_is_seeded_and_deterministic():
+    """The leecher retry law: delays are a pure function of
+    (seed, key, attempt) — identical across instances, different across
+    seeds — with multiplicative backoff inside [base, max*(1+jitter)]
+    and a hard exhaustion budget."""
+    from indy_plenum_tpu.server.catchup.retry import RetryLaw
+
+    a = RetryLaw(base=2.0, mult=1.5, max_delay=20.0, jitter_frac=0.25,
+                 seed=7, max_retries=4)
+    b = RetryLaw(base=2.0, mult=1.5, max_delay=20.0, jitter_frac=0.25,
+                 seed=7, max_retries=4)
+    series_a = [a.delay((1, 101), k) for k in range(1, 10)]
+    series_b = [b.delay((1, 101), k) for k in range(1, 10)]
+    assert series_a == series_b  # replayable bit-for-bit
+    other_seed = RetryLaw(base=2.0, mult=1.5, max_delay=20.0,
+                          jitter_frac=0.25, seed=8, max_retries=4)
+    assert [other_seed.delay((1, 101), k) for k in range(1, 10)] \
+        != series_a
+    # distinct slices desynchronize (the anti-thundering-herd point)
+    assert [a.delay((1, 201), k) for k in range(1, 10)] != series_a
+    # backoff grows and respects the cap (+ jitter headroom)
+    for k, d in enumerate(series_a, start=1):
+        raw = min(2.0 * 1.5 ** (k - 1), 20.0)
+        assert raw <= d <= raw * 1.25
+    assert series_a[0] < series_a[3]
+    # exhaustion budget
+    assert not a.exhausted(4)
+    assert a.exhausted(5)
+    # config plumbing: 0 timeout inherits the legacy knob
+    from indy_plenum_tpu.config import getConfig
+
+    law = RetryLaw.from_config(getConfig({
+        "CatchupRequestTimeout": 0.0, "CatchupTransactionsTimeout": 3.5}))
+    assert law.base == 3.5
+    law = RetryLaw.from_config(getConfig({"CatchupRequestTimeout": 1.25}))
+    assert law.base == 1.25
+
+
+def test_retry_law_reroutes_silent_seeder_and_is_metered():
+    """A seeder that accepts CATCHUP_REQs but never answers: the retry
+    law re-assigns its slices to live peers (metered under
+    catchup.retries) and the round still completes."""
+    from indy_plenum_tpu.common.messages.node_messages import CatchupRep
+    from indy_plenum_tpu.common.metrics_collector import MetricsName
+
+    pool = make_pool(seed=31, CatchupRequestTimeout=1.0,
+                     CatchupBatchSize=2)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+
+    pool.network.disconnect("node3")
+    for i in range(4, 10):
+        pool.submit_request(i)
+    pool.run_for(8)
+
+    # node1 goes catchup-silent: every CatchupRep it sends is dropped
+    pool.network.add_delayer(
+        lambda msg, frm, to: float("inf")
+        if isinstance(msg, CatchupRep) and frm == "node1" else None)
+    pool.network.reconnect("node3")
+    behind = pool.node("node3")
+    behind.leecher.start()
+    pool.run_for(30)
+
+    assert behind.leecher.catchups_completed >= 1
+    assert len(set(domain_sizes(pool))) == 1
+    assert len(set(domain_roots(pool))) == 1
+    stats = behind.leecher.catchup_stats()
+    assert stats["retries"] >= 1
+    assert stats["txns_leeched"] >= 6
+    assert stats["proofs_verified"] >= stats["txns_leeched"]
+    retr = pool.metrics.stat(MetricsName.CATCHUP_RETRIES)
+    assert retr is not None and retr.total >= 1
+
+
+def test_exhausted_retry_budget_fails_round_closed_then_recovers():
+    """Every seeder silent: after CatchupMaxRetries the round FAILS
+    CLOSED (no infinite re-ask; node stays non-participating on the
+    leecher's backoff) — and when the network heals, the scheduled
+    backoff retry completes recovery."""
+    from indy_plenum_tpu.common.messages.node_messages import CatchupRep
+
+    pool = make_pool(seed=32, CatchupRequestTimeout=0.5,
+                     CatchupMaxRetries=3,
+                     CatchupFailedRetryBackoff=2.0,
+                     CatchupFailedRetryBackoffMax=2.0)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+
+    pool.network.disconnect("node2")
+    for i in range(4, 8):
+        pool.submit_request(i)
+    pool.run_for(6)
+
+    undo = pool.network.add_delayer(
+        lambda msg, frm, to: float("inf")
+        if isinstance(msg, CatchupRep) else None)
+    pool.network.reconnect("node2")
+    behind = pool.node("node2")
+    behind.leecher.start()
+    pool.run_for(25)
+
+    assert behind.leecher.catchups_failed >= 1
+    assert behind.data.is_participating is False
+    assert behind.leecher.catchups_completed == 0
+
+    undo()  # seeders answer again -> the backoff retry recovers
+    pool.run_for(15)
+    assert behind.leecher.catchups_completed >= 1
+    assert behind.data.is_participating is True
+    assert len(set(domain_roots(pool))) == 1
+
+
+def test_conflicting_cons_proofs_from_byzantine_seeders():
+    """Byzantine seeders pushing CONFLICTING targets: an unverifiable
+    proof never votes, fewer than f+1 votes never decide, and the
+    honest f+1 quorum's (highest) target wins."""
+    from indy_plenum_tpu.common.event_bus import ExternalBus
+    from indy_plenum_tpu.common.messages.node_messages import (
+        ConsistencyProof,
+    )
+    from indy_plenum_tpu.common.timer import QueueTimer
+    from indy_plenum_tpu.server.catchup.cons_proof_service import (
+        ConsProofService,
+    )
+    from indy_plenum_tpu.server.database_manager import DatabaseManager
+    from indy_plenum_tpu.server.quorums import Quorums
+    from indy_plenum_tpu.utils.base58 import b58encode
+
+    ledger = Ledger()
+    for i in range(4):
+        ledger.add({"k": i})
+    own_size, own_root = ledger.size, ledger.root_hash
+    # the honest chain continues past us
+    honest = Ledger()
+    for i in range(4):
+        honest.add({"k": i})
+    for i in range(4, 10):
+        honest.add({"k": i})
+
+    db = DatabaseManager()
+    db.register_new_database(1, ledger, None)
+    bus = ExternalBus(lambda msg, dst=None: None)
+    service = ConsProofService(1, bus, QueueTimer(), db,
+                               quorums_provider=lambda: Quorums(4))
+    outcome = []
+    service.start(lambda target, diverged: outcome.append(
+        (target, diverged)))
+
+    def proof(end, root_b58, hashes):
+        return ConsistencyProof(
+            ledgerId=1, seqNoStart=own_size, seqNoEnd=end,
+            viewNo=None, ppSeqNo=None,
+            oldMerkleRoot=b58encode(own_root),
+            newMerkleRoot=root_b58, hashes=hashes)
+
+    # byzantine: a FORGED target (made-up root, garbage proof) — fails
+    # cryptographic verification, so it never becomes a vote however
+    # many byzantine senders repeat it
+    forged = proof(12, b58encode(b"\x05" * 32),
+                   [b58encode(b"\x06" * 32)])
+    service.process_consistency_proof(forged, "evil1")
+    service.process_consistency_proof(forged, "evil2")
+    assert not outcome and not service._votes
+
+    # one honest vote (f+1 = 2 not reached yet): no decision
+    good_hashes = [b58encode(h)
+                   for h in honest.consistency_proof(own_size)]
+    good = proof(honest.size, b58encode(honest.root_hash), good_hashes)
+    service.process_consistency_proof(good, "peer1")
+    assert not outcome
+
+    # a SECOND distinct honest voter reaches f+1: the verified target
+    # decides — byzantine noise never contributed
+    service.process_consistency_proof(good, "peer2")
+    assert outcome == [((honest.size, b58encode(honest.root_hash)),
+                        False)]
+
+
+def test_fork_point_on_gc_checkpoint_boundary():
+    """Divergence whose fork sits EXACTLY on a checkpoint boundary that
+    has been stabilized and GC'd pool-wide: the fork search still pins
+    the honest prefix and only the suffix past the boundary refetches."""
+    from indy_plenum_tpu.common.messages.node_messages import CatchupReq
+
+    pool = make_pool(seed=33)
+    for i in range(8):
+        pool.submit_request(i)
+    pool.run_for(10)
+    assert len(set(domain_roots(pool))) == 1
+
+    evil = pool.node("node1")
+    domain = evil.boot.db.get_ledger(DOMAIN_LEDGER_ID)
+    audit = evil.boot.db.get_ledger(AUDIT_LEDGER_ID)
+    chk = pool.config.CHK_FREQ
+    # fork exactly on a checkpoint boundary (a multiple of CHK_FREQ,
+    # strictly below the tip so there IS a corrupt tail)
+    fork_at = ((domain.size - 1) // chk) * chk
+    assert fork_at >= chk and fork_at % chk == 0
+    tail = domain.size - fork_at
+    domain.reset_to(fork_at)
+    audit_fork = audit.size - tail
+    audit.reset_to(audit_fork)
+    for i in range(tail):
+        domain.add({"fake": i})
+        audit.add({"fake_audit": i})
+
+    reqs = []
+    pool.network.add_delayer(
+        lambda msg, frm, to: reqs.append(msg) or None
+        if isinstance(msg, CatchupReq) and frm == "node1" else None)
+    evil.leecher.start()
+    pool.run_for(30)
+
+    assert evil.boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash == \
+        pool.node("node0").boot.db.get_ledger(DOMAIN_LEDGER_ID).root_hash
+    # only the suffix past the boundary was refetched
+    domain_reqs = [r for r in reqs if r.ledgerId == DOMAIN_LEDGER_ID]
+    assert domain_reqs
+    assert min(r.seqNoStart for r in domain_reqs) >= fork_at
+    # pool still agrees on fresh traffic
+    for i in range(50, 53):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert len(set(domain_roots(pool))) == 1
+    assert len(set(domain_sizes(pool))) == 1
+
+
+def test_empty_ledger_catchup_resyncs_everything():
+    """A node with EMPTY ledgers (wiped storage, genesis lost): catchup
+    fetches the entire history — genesis included — and rebuilds the
+    derived state to match the pool."""
+    pool = make_pool(seed=34)
+    for i in range(4):
+        pool.submit_request(i)
+    pool.run_for(6)
+    assert len(set(domain_roots(pool))) == 1
+
+    wiped = pool.node("node2")
+    for lid in (DOMAIN_LEDGER_ID, AUDIT_LEDGER_ID):
+        wiped.boot.db.get_ledger(lid).reset_to(0)
+    assert wiped.boot.db.get_ledger(DOMAIN_LEDGER_ID).size == 0
+
+    wiped.leecher.start()
+    pool.run_for(20)
+
+    assert len(set(domain_sizes(pool))) == 1, domain_sizes(pool)
+    assert len(set(domain_roots(pool))) == 1
+    assert wiped.boot.db.get_state(DOMAIN_LEDGER_ID).committed_head_hash \
+        == pool.node("node0").boot.db.get_state(
+            DOMAIN_LEDGER_ID).committed_head_hash
+    # and the node is live again
+    pre = min(domain_sizes(pool))
+    for i in range(100, 103):
+        pool.submit_request(i)
+    pool.run_for(8)
+    assert domain_sizes(pool) == [pre + 3] * 4
+
+
+def test_catchup_trace_spans_and_monitor_block():
+    """Leecher rounds are trace spans joined into the phase-latency
+    machinery, and Monitor.snapshot() surfaces the catchup meters."""
+    from indy_plenum_tpu.common.event_bus import InternalBus
+    from indy_plenum_tpu.common.metrics_collector import (
+        MetricsCollector,
+        MetricsName,
+    )
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.observability.trace import phase_percentiles
+    from indy_plenum_tpu.server.monitor import Monitor
+    from indy_plenum_tpu.simulation.mock_timer import MockTimer
+    from indy_plenum_tpu.simulation.pool import SimPool
+
+    cfg = dict(CATCHUP_CONFIG)
+    pool = SimPool(4, seed=35, real_execution=True,
+                   config=getConfig(cfg), trace=True)
+    for i in range(2):
+        pool.submit_request(i)
+    pool.run_for(5)
+    pool.network.disconnect("node3")
+    for i in range(2, 10):
+        pool.submit_request(i)
+    pool.run_for(10)
+    pool.network.reconnect("node3")
+    pool.node("node3").leecher.start()
+    pool.run_for(12)
+    assert pool.node("node3").leecher.catchups_completed >= 1
+
+    events = pool.trace.events()
+    names = {e["name"] for e in events}
+    assert {"catchup.started", "catchup.txns_leeched",
+            "catchup.completed"} <= names
+    done = [e for e in events if e["name"] == "catchup.completed"]
+    assert done and done[-1]["args"]["txns_leeched"] >= 1
+    assert done[-1]["args"]["proofs_verified"] >= \
+        done[-1]["args"]["txns_leeched"]
+    # the catchup phase joins phase_latency (per node + pool-wide)
+    phases = phase_percentiles(events, node="node3")
+    assert "catchup" in phases and phases["catchup"]["count"] >= 1
+    assert phases["catchup"]["p50"] > 0
+
+    # Monitor catchup block from the shared collector
+    timer = MockTimer()
+    monitor = Monitor("node3", timer, InternalBus(),
+                      getConfig(), num_instances=1, metrics=pool.metrics)
+    snap = monitor.snapshot()
+    assert snap["catchup"]["rounds"] >= 1
+    assert snap["catchup"]["txns_leeched"] >= 1
+    assert snap["catchup"]["proofs_verified"] >= \
+        snap["catchup"]["txns_leeched"]
+    # a fresh collector with no catchup events has NO block (snapshots
+    # stay byte-compatible for non-leeching nodes)
+    empty = Monitor("x", timer, InternalBus(), getConfig(),
+                    num_instances=1, metrics=MetricsCollector())
+    assert "catchup" not in empty.snapshot()
+    assert MetricsName.CATCHUP_TXNS_LEECHED  # name registered
+
+
 def test_adaptive_offload_policy_selects_measured_winner():
     from indy_plenum_tpu.server.catchup.catchup_rep_service import (
         _AdaptiveOffload,

@@ -247,3 +247,148 @@ def test_storm_scenario_soak():
     assert report.failed == [], report.invariants
     assert report.network["duplicated"] > 0
     assert report.network["dropped"] > 0
+
+
+# --- catchup plane: recovery across checkpoint GC -------------------------
+
+
+def test_f_crash_gc_catchup_recovers_and_serves_proved_read():
+    """The window-crossing acceptance arc: a node crashes, >= 2
+    checkpoint windows stabilize and GC in its absence, it restarts,
+    completes a full leecher round with every leeched batch audit-proof
+    verified, rejoins ordering with a committed ledger bit-identical to
+    the survivors, and serves a proof-attached read from the window it
+    just leeched that passes verify_proved_read — and the whole run
+    replays byte-identically from its seed."""
+    report = run_scenario("f_crash_gc_catchup", seed=7, trace=True)
+    assert report.failed == [], report.invariants
+    assert report.verdict_as_expected
+    names = {r["name"] for r in report.invariants}
+    assert {"catchup_recovery", "catchup_proof_read"} <= names
+
+    cu = report.catchup
+    assert cu["rounds"] >= 1
+    assert cu["txns_leeched"] >= 4  # >= 2 GC'd windows of CHK_FREQ=2
+    assert cu["proofs_verified"] >= cu["txns_leeched"]
+    assert cu["restarted_nodes"], "no restarted victim recorded"
+    victim = cu["restarted_nodes"][0]
+    assert cu["per_node"][victim]["rounds_completed"] >= 1
+    # the caught-up node's committed ledger is bit-identical to EVERY
+    # survivor's (ordered_log alone can't show this: it legitimately
+    # skips the leeched middle)
+    assert len(set(cu["ledger_hash_per_node"].values())) == 1
+    # the proof-read closing check really verified client-side
+    assert cu["proof_read"]["verified"] is True
+    assert cu["proof_read"]["node"] == victim
+    assert cu["proof_read"]["has_multi_sig"] is True
+
+    # byte-identical replay (trace_hash is the fingerprint)
+    replay = run_scenario("f_crash_gc_catchup", seed=7, trace=True)
+    assert replay.trace_hash == report.trace_hash
+    assert replay.catchup == report.catchup
+
+
+def test_byzantine_seeder_catchup_rejection_is_asserted():
+    """Corrupted CATCHUP_REPs from a byzantine seeder are rejected by
+    audit-proof verification — asserted via the reps_rejected meter and
+    the catchup_rejection verdict, not assumed from a green run."""
+    report = run_scenario("byzantine_seeder_catchup", seed=7)
+    assert report.failed == [], report.invariants
+    cu = report.catchup
+    assert cu["reps_rejected"] >= 1
+    assert cu["rounds"] >= 1
+    assert cu["proofs_verified"] >= cu["txns_leeched"] >= 1
+    # rejections forced re-assignment to honest seeders
+    assert cu["retries"] >= 1
+    rejection = next(r for r in report.invariants
+                     if r["name"] == "catchup_rejection")
+    assert rejection["verdict"] == "PASS"
+    assert len(set(cu["ledger_hash_per_node"].values())) == 1
+
+
+def test_silent_seeder_catchup_retry_law_reroutes():
+    """A seeder silent on the whole catchup plane: the seeded retry law
+    re-requests its slices from live peers (catchup_retry verdict) and
+    recovery completes."""
+    report = run_scenario("silent_seeder_catchup", seed=7)
+    assert report.failed == [], report.invariants
+    assert report.catchup["retries"] >= 1
+    retry = next(r for r in report.invariants
+                 if r["name"] == "catchup_retry")
+    assert retry["verdict"] == "PASS"
+    assert len(set(report.catchup["ledger_hash_per_node"].values())) == 1
+
+
+def test_ic_storm_forces_instance_change_mid_catchup(tmp_path):
+    """Byzantine backup primary + stalled master while the victim is
+    leeching: the ordering-stall watchdog forces an instance change
+    mid-catchup (asserted from the vc.started trace mark in the dump)
+    and recovery still completes on the new view."""
+    trace_out = str(tmp_path / "ic_storm.trace.jsonl")
+    report = run_scenario("ic_storm_mid_catchup", seed=7, trace=True,
+                          trace_out=trace_out)
+    assert report.failed == [], report.invariants
+    assert report.catchup["rounds"] >= 1
+    assert len(set(report.catchup["ledger_hash_per_node"].values())) == 1
+    # the storm genuinely forced a view change mid-run AND the catchup
+    # spans bracket it (not a quiet pass-through)
+    with open(trace_out) as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    names = [e["name"] for e in events]
+    assert "vc.started" in names
+    assert "catchup.started" in names and "catchup.completed" in names
+
+
+def test_catchup_scenarios_registered_and_listed():
+    for name in ("f_crash_gc_catchup", "byzantine_seeder_catchup",
+                 "silent_seeder_catchup", "ic_storm_mid_catchup"):
+        sc = SCENARIOS[name]
+        assert sc.real_execution
+        assert sc.require_catchup
+        json.dumps(sc.plan(seed=4).as_dicts())  # report-serializable
+    assert SCENARIOS["f_crash_gc_catchup"].bls
+    assert SCENARIOS["f_crash_gc_catchup"].proof_read
+    assert SCENARIOS["byzantine_seeder_catchup"].require_rejection
+    assert SCENARIOS["silent_seeder_catchup"].require_retries
+    # the byzantine seeder fault marks its node byzantine
+    plan = SCENARIOS["byzantine_seeder_catchup"].plan(seed=4)
+    assert plan.byzantine_nodes
+    assert plan.restarted_nodes
+
+
+def test_chaos_run_list_prints_scenarios(tmp_path):
+    """scripts/chaos_run.py --list: every registered scenario with its
+    expect_fail / assert tags — discoverability without a grep."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "chaos_run.py"),
+         "--list"],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    for name in SCENARIOS:
+        assert name in out.stdout
+    assert "expects FAIL: agreement" in out.stdout  # broken_agreement
+    assert "asserts catchup, byz-seeder-rejection" in out.stdout
+    assert "real-exec+bls" in out.stdout
+
+
+@pytest.mark.slow
+def test_catchup_chaos_on_tick_dispatch_plane():
+    """The same GC-crossing arc through the tick-batched device dispatch
+    plane (adaptive governor): all verdicts PASS and the committed
+    ledgers are bit-identical to the host-eval per-message run — catchup
+    is dispatch-mode invariant."""
+    device = run_scenario("f_crash_gc_catchup", seed=7,
+                          device_quorum=True, quorum_tick_interval=0.05,
+                          quorum_tick_adaptive=True)
+    assert device.failed == [], device.invariants
+    host = run_scenario("f_crash_gc_catchup", seed=7)
+    assert device.catchup["ledger_hash_per_node"] == \
+        host.catchup["ledger_hash_per_node"]
+    assert device.catchup["proof_read"]["verified"] is True
+    assert "--device-quorum" in device.replay_command
+    assert "--tick 0.05" in device.replay_command

@@ -183,6 +183,11 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": 0.1,
         "QuorumTickAdaptive": True,
+        # net-mark fan-out cap (causal plane): the 3PC waves are O(n^2)
+        # messages per batch at n=64+ — stamp deliveries into the first
+        # 4 validators only, keeping per-wave latency stats
+        # representative without flooding the ring
+        "TraceNetReceivers": 4,
     })
     # flight recorder on: the phase split below is what lets a future
     # BENCH_r*.json attribute a throughput regression to a phase instead
@@ -290,6 +295,21 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
     trace_events = pool.trace.events()
     out["phase_latency"] = phase_percentiles(trace_events)
     out["critical_path"] = critical_path(trace_events)
+    # causal request journeys (ISSUE 12): client-observed e2e latency
+    # percentiles with network/queue/compute/device attribution — the
+    # ground truth the per-phase block approximates, byte-stable per
+    # seed (journey_hash) like ordered_hash
+    from indy_plenum_tpu.observability.causal import journey_summary
+
+    js = journey_summary(trace_events)
+    out["e2e_latency"] = {
+        "write": js["e2e"]["write"],
+        "complete": js["complete"],
+        "count": js["count"],
+        "orphan_spans": js["orphan_spans"],
+        "attribution_share": js["attribution_share"],
+        "journey_hash": js["journey_hash"],
+    }
     if mesh is not None:
         out["shard_occupancy"] = pool.vote_group.shard_occupancy
     if pool.governor is not None:
@@ -951,8 +971,24 @@ def _run_saturation(serve_reads: bool, seed: int = 29) -> dict:
 
     events = pool.trace.events()
     phases = phase_percentiles(events)
+    from indy_plenum_tpu.observability.causal import journey_summary
+
+    js = journey_summary(events)
     return {
         "ordered": ordered,
+        # causal journeys under saturation: what an ADMITTED request's
+        # end-to-end latency looked like while the shed law and the
+        # governor's backpressure narrowing were both engaged — plus
+        # the proof-read e2e when this arm served reads
+        "e2e_latency": {
+            "write": js["e2e"]["write"],
+            "read": js["e2e"]["read"],
+            "complete": js["complete"],
+            "count": js["count"],
+            "shed": js["shed"],
+            "attribution_share": js["attribution_share"],
+            "journey_hash": js["journey_hash"],
+        },
         "wall_s": wall_s,
         "sim_elapsed_s": sim_elapsed,
         "workload": gen.counters(),
@@ -1016,6 +1052,10 @@ def bench_saturation() -> dict:
         # earliest req.finalised per request, in VIRTUAL protocol time
         "ingress_to_finalised_p50_s": p.get("p50"),
         "ingress_to_finalised_p99_s": p.get("p99"),
+        # causal journeys: the FULL client-observed e2e under overload
+        # (ingress -> executed), write and proof-read classes, with
+        # network/queue/compute/device attribution
+        "e2e_latency": with_reads["e2e_latency"],
         "phase_latency": with_reads["phase_latency"],
         "critical_path": with_reads["critical_path"],
         "flush_occupancy": with_reads["flush_occupancy"],

@@ -61,6 +61,13 @@ class ChaosReport:
     trace_hash: Optional[str] = None
     trace_file: Optional[str] = None
     flight_recorder: List[Dict[str, Any]] = field(default_factory=list)
+    # causal request journeys (observability.causal, traced runs only):
+    # journey counts + completeness, the byte-stable journey_hash, e2e
+    # percentiles per request class, and — because chaos fault begin/end
+    # marks ride the same timeline — the measured latency cost of the
+    # requests whose journey crossed a fault window vs the ones that
+    # ran clear
+    journeys: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> List[str]:
@@ -116,6 +123,7 @@ class ChaosReport:
             "trace_hash": self.trace_hash,
             "trace_file": self.trace_file,
             "flight_recorder": self.flight_recorder,
+            "journeys": self.journeys,
         }
 
     def to_json(self, indent: int = 2) -> str:
@@ -154,6 +162,23 @@ class ChaosReport:
                     f"  proof read: node={pr.get('node')} "
                     f"index={pr.get('index')} window={pr.get('window')} "
                     f"verified={pr.get('verified')}")
+        if self.journeys:
+            j = self.journeys
+            e2e = (j.get("e2e") or {}).get("write") or {}
+            lines.append(
+                f"  journeys: {j.get('complete')}/{j.get('count')} "
+                f"complete (orphans={j.get('orphan_spans')}, "
+                f"via_catchup={j.get('catchup_journeys')}) "
+                f"e2e p50={e2e.get('p50')} p99={e2e.get('p99')} "
+                f"hash={str(j.get('journey_hash'))[:16]}…")
+            fw = j.get("fault_window")
+            if fw:
+                lines.append(
+                    f"  fault cost: {fw['through_fault']['count']} "
+                    f"journeys crossed a fault window "
+                    f"(p50 {fw['through_fault']['p50']} vs "
+                    f"{fw['clear']['p50']} clear; "
+                    f"p50_cost={fw['p50_cost']})")
         if self.trace_hash is not None:
             dumped = ", ".join(sorted({d.get("reason", "?")
                                        for d in self.flight_recorder})) \

@@ -310,6 +310,12 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         jsonl = pool.trace.to_jsonl()
         report.trace_hash = hashlib.sha256(jsonl.encode()).hexdigest()
         report.flight_recorder = [dict(d) for d in pool.trace.dumps]
+        # causal request journeys: cross-node e2e latency with the
+        # fault windows' measured cost (a journey that spans a fault
+        # window shows the fault's latency price directly)
+        from ..observability.causal import journey_summary
+
+        report.journeys = journey_summary(pool.trace.events())
         if trace_out is not None:
             with open(trace_out, "w") as fh:
                 fh.write(jsonl)

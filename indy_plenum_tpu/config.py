@@ -190,6 +190,14 @@ class Config:
     # records perf_counter durations instead.
     TraceRecorderEnabled: bool = False
     TraceRecorderCapacity: int = 65536
+    # causal tracing plane (observability.causal): when tracing is on,
+    # the transports stamp net.send/net.recv marks for journey-joinable
+    # message types. The 3PC waves are O(n^2) messages per batch, so
+    # large-pool benches cap the stamped fan-out to deliveries into the
+    # first K validators (0 = stamp every delivery) — the sampled set
+    # keeps per-wave latency stats representative without drowning the
+    # ring
+    TraceNetReceivers: int = 0
     # logging (reference: stp logging config + rotating handler)
     logLevel: str = "INFO"
     logRotationMaxBytes: int = 10 * 1024 * 1024

@@ -202,7 +202,7 @@ class ReadService:
     def __init__(self, backing, clock: Optional[Callable[[], float]] = None,
                  metrics=None, trace=None, max_batch: int = 16384,
                  mode: str = "auto", proof_cache=None,
-                 capacity: int = 0, seed: int = 0):
+                 capacity: int = 0, seed: int = 0, name: str = ""):
         from ..common.metrics_collector import MetricsCollector
         from ..observability.trace import NULL_TRACE
 
@@ -219,6 +219,10 @@ class ReadService:
         self.metrics = metrics if metrics is not None \
             else MetricsCollector()
         self.trace = trace if trace is not None else NULL_TRACE
+        # service identity on the read journey marks: two services
+        # sharing one recorder (or N merged per-node dumps) pair their
+        # submitted/served FIFO windows independently in causal.py
+        self.name = name
         self.max_batch = int(max_batch)
         self._queue: List[int] = []
         self.admission = None
@@ -266,6 +270,13 @@ class ReadService:
         idx = index % size
         if self.admission is None:
             self._queue.append(idx)
+            if self.trace.enabled:
+                # read-journey start (causal plane): serves pair with
+                # these FIFO per service, giving per-read e2e without a
+                # per-item id on the serve path. Unbounded mode only —
+                # a bounded queue's seeded shed would break the pairing.
+                self.trace.record("read.submitted", cat="read",
+                                  node=self.name)
             return True
         self._read_seq += 1
         return self.admission.offer(_QueuedRead(self._read_seq, idx))
@@ -298,6 +309,13 @@ class ReadService:
                 self.metrics.add_event(MetricsName.READ_SHED, len(shed))
         else:
             queued, self._queue = self._queue, []
+            if queued and self.trace.enabled:
+                # read-journey end: one mark per drain closes the FIFO
+                # window the submitted marks opened (per-read e2e =
+                # serve ts - submit ts, in causal.py)
+                self.trace.record("read.served", cat="read",
+                                  node=self.name,
+                                  args={"n": len(queued)})
         if not queued:
             return []
         from ..server.catchup.catchup_rep_service import (

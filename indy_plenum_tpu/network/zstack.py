@@ -60,6 +60,16 @@ class ZStack:
         self._max_batch = max_batch
         self._msg_len_limit = msg_len_limit
         self._metrics = metrics  # optional MetricsCollector
+        # causal tracing plane: with a recorder attached (build_node
+        # wires the Node's), journey-joinable messages piggyback a
+        # ``~trc`` context on the serialized envelope — {id, sender,
+        # sender-clock send ts} — and both ends stamp net.send/net.recv
+        # marks. The receiver strips the context before schema
+        # validation, so untraced peers interoperate unchanged.
+        from ..observability.trace import NULL_TRACE
+
+        self.trace = NULL_TRACE
+        self._net_seq = 0
 
         self._ctx = zmq.Context()
         # never block interpreter shutdown: ctx.term() waits for open
@@ -269,12 +279,47 @@ class ZStack:
     def send(self, msg, dst: Optional[List[str]] = None) -> None:
         """Queue ``msg`` (a MessageBase or dict) for peers; coalesced into
         Batch envelopes at the next service() flush."""
-        data = serialize_msg(msg.as_dict() if hasattr(msg, "as_dict")
-                             else msg)
+        obj = msg.as_dict() if hasattr(msg, "as_dict") else msg
         targets = list(self._remotes) if dst is None else dst
+        key = None
+        if self.trace.enabled and isinstance(obj, dict):
+            from ..observability.causal import (
+                NET_TRACED_OPS,
+                net_join_key,
+            )
+
+            op = obj.get("op")
+            if op in NET_TRACED_OPS:
+                key = net_join_key(op, obj.get)
+        if key is None:
+            data = serialize_msg(obj)
+            for peer in targets:
+                if peer in self._remotes:
+                    self._outbox[peer].append(data)
+            return
+        # traced: each copy carries its own context (per-peer flow id),
+        # so the envelope itself is the propagation vehicle — the
+        # receiving node needs no shared state to join the hop
+        ts = time.perf_counter()
         for peer in targets:
-            if peer in self._remotes:
-                self._outbox[peer].append(data)
+            if peer not in self._remotes:
+                continue
+            self._net_seq += 1
+            nid = "%s:%d" % (self.name, self._net_seq)
+            data = serialize_msg(dict(
+                obj, **{"~trc": {"id": nid, "frm": self.name,
+                                 "sent": ts}}))
+            if len(data) > self._msg_len_limit:
+                # near-limit payload: the context would push it past the
+                # receiver's oversize drop — tracing must NEVER change
+                # what gets delivered, so this copy ships untraced
+                self._outbox[peer].append(serialize_msg(obj))
+                continue
+            self.trace.record("net.send", cat="net", node=self.name,
+                              key=key,
+                              args={"m": obj["op"], "to": peer,
+                                    "id": nid}, ts=ts)
+            self._outbox[peer].append(data)
 
     def _flush(self) -> None:
         for peer, queue in self._outbox.items():
@@ -355,11 +400,30 @@ class ZStack:
             return
         try:
             data = deserialize_msgpack(payload)
+            # piggybacked trace context (causal tracing plane): strip it
+            # BEFORE schema validation — the wire context is advisory
+            # observability, never protocol surface
+            ctx = data.pop("~trc", None) if isinstance(data, dict) \
+                else None
             msg = node_message_registry.obj_from_dict(data)
         except Exception as exc:  # noqa: BLE001 — wire data is untrusted
             logger.warning("%s: bad message from %s: %s", self.name,
                            sender, exc)
             return
+        if ctx is not None and self.trace.enabled:
+            from ..observability.causal import net_join_key
+
+            op = data.get("op")
+            key = net_join_key(op, data.get) if op else None
+            if key is not None:
+                # args carry the SENDER's clock reading: the two hosts'
+                # clocks differ, so causal joins use it as an offset
+                # estimate, not a shared timeline
+                self.trace.record(
+                    "net.recv", cat="net", node=self.name, key=key,
+                    args={"m": op, "frm": sender,
+                          "id": ctx.get("id"),
+                          "sent": ctx.get("sent")})
         if isinstance(msg, Batch):
             # byzantine guards: a batch inside a batch is never legitimate
             # (unbounded recursion), and elements must be bytes (the field

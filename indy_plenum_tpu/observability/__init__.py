@@ -1,5 +1,15 @@
 """Consensus flight recorder: deterministic span traces for the 3PC
-lifecycle and the dispatch plane (README "Observability")."""
+lifecycle and the dispatch plane, plus the causal tracing plane that
+joins them into cross-node request journeys (README "Observability")."""
+from .causal import (  # noqa: F401
+    build_journeys,
+    journey_for,
+    journey_hash,
+    journey_summary,
+    merge_events,
+    span_id,
+    trace_id,
+)
 from .trace import (  # noqa: F401
     NULL_TRACE,
     NullTraceRecorder,

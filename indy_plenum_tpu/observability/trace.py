@@ -550,7 +550,13 @@ def to_chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Chrome trace-event JSON: one pid per node (pool-level events ride
     pid "pool"), one tid per category; spans (events with ``dur``) become
     complete "X" events, marks become instant "i" events. Timestamps are
-    microseconds per the format spec."""
+    microseconds per the format spec.
+
+    Transport marks (cat ``net``, the causal tracing plane) additionally
+    emit **flow events**: each matched ``net.send``/``net.recv`` pair
+    becomes an "s"/"f" flow arc between the sender's and receiver's
+    pids, so a request's PROPAGATE/3PC journey renders as arrows hopping
+    across node tracks in Perfetto."""
     nodes = sorted({ev.get("node", "") for ev in events})
     cats = sorted({ev.get("cat", "") for ev in events})
     pid_of = {n: i + 1 for i, n in enumerate(nodes)}
@@ -578,11 +584,37 @@ def to_chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
         if args:
             rec["args"] = args
+        is_net_mark = (ev.get("cat") == "net"
+                       and ev["name"] in ("net.send", "net.recv"))
         if ev.get("dur") is not None:
             rec["ph"] = "X"
             rec["dur"] = round(ev["dur"] * 1e6, 3)
+        elif is_net_mark:
+            # flow ends must bind to an ENCLOSING duration slice per the
+            # trace-event spec — an instant can't anchor an arrow — so
+            # transport marks render as 1µs slices
+            rec["ph"] = "X"
+            rec["dur"] = 1.0
         else:
             rec["ph"] = "i"
             rec["s"] = "p"
         out.append(rec)
+        # flow arcs: a send/recv pair shares args["id"]; the send is the
+        # flow start ("s") on the sender's pid, the recv binds the end
+        # ("f", enclosing slice) on the receiver's — Perfetto draws the
+        # cross-node arrow
+        if is_net_mark:
+            flow_id = (ev.get("args") or {}).get("id")
+            if flow_id is not None:
+                out.append({
+                    "ph": "s" if ev["name"] == "net.send" else "f",
+                    "bp": "e",
+                    "id": str(flow_id),
+                    "name": "net." + str((ev.get("args") or {})
+                                         .get("m", "msg")),
+                    "cat": "net",
+                    "pid": rec["pid"],
+                    "tid": rec["tid"],
+                    "ts": rec["ts"],
+                })
     return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -559,9 +559,14 @@ class OrderingService:
             self._vote_plane.record_preprepare(pp.ppSeqNo)
         self._network.send(pp)
         if self._trace.enabled:
+            # reqIdr rides the primary's send mark ONCE per batch: the
+            # causal plane's request->batch join (journeys need to know
+            # which requests a (view, seq, digest) batch carried, and
+            # the batch digest is not invertible)
             self._trace.record("3pc.preprepare_sent", node=self.name,
                                key=(pp.viewNo, pp.ppSeqNo, pp.digest),
-                               args={"reqs": len(reqs)})
+                               args={"reqs": len(reqs),
+                                     "reqIdr": [r.digest for r in reqs]})
         logger.debug("%s sent PRE-PREPARE %s (%d reqs)", self.name, key,
                      len(reqs))
         return pp

@@ -281,6 +281,35 @@ class Monitor:
                                        node=self._name)
             if phases:
                 snap["phase_latency"] = phases
+            # pool-rollup end-to-end latency (causal tracing plane):
+            # journeys join the recorder's cross-node marks, so the
+            # block reports what a CLIENT experienced — e2e percentiles
+            # per request class, per-hop percentiles, and where the
+            # time went (network / queue / compute / device-dispatch).
+            # Pool-level on purpose: a journey spans nodes, so every
+            # node's snapshot reports the same rollup.
+            from ..observability.causal import journey_summary
+
+            # the rollup is pool-level and the recorder is pool-shared:
+            # cache it ON the recorder keyed by its event seq, so an
+            # n-node snapshot sweep computes the journey table once per
+            # ring generation instead of n times
+            cache = getattr(self._trace, "_journey_rollup", None)
+            if cache is not None and cache[0] == self._trace._seq:
+                js = cache[1]
+            else:
+                js = journey_summary(self._trace.events())
+                self._trace._journey_rollup = (self._trace._seq, js)
+            if js["count"] or js["e2e"]["read"]["count"]:
+                snap["e2e_latency"] = {
+                    "write": js["e2e"]["write"],
+                    "read": js["e2e"]["read"],
+                    "complete": js["complete"],
+                    "orphan_spans": js["orphan_spans"],
+                    "hop_percentiles": js["hop_percentiles"],
+                    "attribution_share": js["attribution_share"],
+                    "journey_hash": js["journey_hash"],
+                }
         return snap
 
     def master_throughput_ratio(self) -> Optional[float]:

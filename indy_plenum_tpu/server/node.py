@@ -22,6 +22,7 @@ material beyond the genesis seed.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 from ..common.constants import DOMAIN_LEDGER_ID, POOL_LEDGER_ID
@@ -475,6 +476,35 @@ class Node:
         # flush_every - 1 events otherwise (no-op on the plain collector)
         self.metrics.close()
 
+    def install_signal_handlers(self,
+                                dump_dir: Optional[str] = None) -> bool:
+        """Deployed-node flight dump on ``SIGUSR2``: an operator can
+        snapshot the ring on a LIVE node (``kill -USR2 <pid>``) without
+        stopping it — the handler rides the existing ``trigger_dump``
+        path (a ``flight.signal`` mark + bounded ring-tail snapshot) and,
+        with ``dump_dir``, writes the full JSONL dump for ``trace_tool``.
+
+        Deliberately NOT called by Node.__init__: only process entry
+        points (``scripts/start_node.py``) install handlers — SimPool /
+        NodePool / tests must never mutate process-global signal state.
+        Returns False (and installs nothing) off the main thread or on
+        platforms without SIGUSR2."""
+        import signal
+        import threading
+
+        if not hasattr(signal, "SIGUSR2") \
+                or threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _on_usr2(signum, frame):
+            self.trace.trigger_dump("signal", node=self.name)
+            if dump_dir is not None and self.trace.enabled:
+                self.trace.dump(os.path.join(
+                    dump_dir, f"{self.name}.flight.jsonl"))
+
+        signal.signal(signal.SIGUSR2, _on_usr2)
+        return True
+
     def _quorum_tick(self) -> None:
         # dispatch-plane order: drain the signed-request ingress (one
         # device auth batch), scatter buffered votes (one grouped device
@@ -602,8 +632,13 @@ class Node:
         if client_id is not None:
             self._req_clients[req.digest] = client_id
         if self.trace.enabled:
+            # rid: the "identifier|reqId" pair the wire-level PROPAGATE
+            # marks carry (the envelope never sees the digest) — the
+            # causal plane's ingress->propagate join key
             self.trace.record("req.ingress", cat="req", node=self.name,
-                              key=(req.digest,))
+                              key=(req.digest,),
+                              args={"rid": "%s|%s" % (req.identifier,
+                                                      req.reqId)})
         if self.admission is not None:
             # bounded ingress: the shed decision is made NOW (drop-newest,
             # seeded tiebreak); the client's NACK and the shed accounting
@@ -730,6 +765,12 @@ class Node:
             batch = admitted + batch
         if not batch:
             return signal
+        if self.trace.enabled:
+            # journey hop boundary: admission wait ends, auth batch
+            # begins — one mark per request entering the device batch
+            for req in batch:
+                self.trace.record("req.admitted", cat="req",
+                                  node=self.name, key=(req.digest,))
         self.metrics.add_event(MetricsName.AUTH_BATCH_SIZE, len(batch))
         with self.metrics.measure_time(MetricsName.AUTH_BATCH_TIME):
             verdicts = self.authnr.authenticate_batch(batch)

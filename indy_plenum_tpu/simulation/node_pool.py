@@ -62,8 +62,13 @@ class NodePool:
             self.timer.get_current_time,
             capacity=self.config.TraceRecorderCapacity)
             if trace else NULL_TRACE)
-        self.network = SimNetwork(self.timer, seed=seed,
-                                  metrics=self.metrics)
+        # causal tracing plane: PROPAGATE fan-out and 3PC waves between
+        # real Node compositions stamp net.send/net.recv on the shared
+        # recorder — journeys join them across nodes
+        self.network = SimNetwork(
+            self.timer, seed=seed, metrics=self.metrics,
+            trace=self.trace,
+            trace_receivers=self.config.TraceNetReceivers)
         self.validators = [f"node{i}" for i in range(n_nodes)]
 
         self.trustee = DidSigner(b"\x09" * 32)

@@ -406,8 +406,13 @@ class SimPool:
             self.timer.get_current_time,
             capacity=trace_capacity or self.config.TraceRecorderCapacity)
             if trace else NULL_TRACE)
-        self.network = SimNetwork(self.timer, seed=seed,
-                                  metrics=self.metrics)
+        # causal tracing plane: the network stamps net.send/net.recv
+        # marks on the same recorder, so cross-node journeys carry
+        # measured (delayer-inclusive) per-hop network latency
+        self.network = SimNetwork(
+            self.timer, seed=seed, metrics=self.metrics,
+            trace=self.trace,
+            trace_receivers=self.config.TraceNetReceivers)
         self.validators = [f"node{i}" for i in range(n_nodes)]
         # RBFT: f+1 parallel protocol instances (0 = auto f+1); backup
         # instances get their own finalised-request queue per (node, inst)
@@ -687,6 +692,12 @@ class SimPool:
             if batch:
                 self.metrics.add_event(MetricsName.INGRESS_ADMITTED,
                                        len(batch))
+            if trace_on:
+                # journey hop boundary: admission wait ends (and the
+                # auth device batch begins) at the tick's drain instant
+                for req in batch:
+                    self.trace.record("req.admitted", cat="req",
+                                      key=(req.digest,))
             if shed:
                 self.metrics.add_event(MetricsName.INGRESS_SHED,
                                        len(shed))
@@ -754,7 +765,7 @@ class SimPool:
             backing, clock=self.timer.get_current_time,
             metrics=self.metrics, trace=self.trace, mode=mode,
             proof_cache=node.proof_cache, capacity=capacity,
-            seed=self.config.IngressShedSeed or self.seed)
+            seed=self.config.IngressShedSeed or self.seed, name=name)
 
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Union
 
 from ..common.event_bus import ExternalBus
 from ..common.metrics_collector import MetricsCollector, MetricsName
+from ..observability.causal import NET_TRACED_OPS, net_join_key
 from .mock_timer import MockTimer
 
 # a delayer: (msg, frm, to) -> None | float | sequence of floats.
@@ -47,14 +48,31 @@ def delay_message_types(*types, frm: Optional[str] = None,
 class SimNetwork:
     def __init__(self, timer: MockTimer, seed: int = 0,
                  min_latency: float = 0.01, max_latency: float = 0.05,
-                 metrics: Optional[MetricsCollector] = None):
+                 metrics: Optional[MetricsCollector] = None,
+                 trace=None, trace_receivers: int = 0):
         self._timer = timer
         self._rng = random.Random(seed)
         self._min_latency = min_latency
         self._max_latency = max_latency
         self._peers: Dict[str, ExternalBus] = {}
+        self._peer_order: list[str] = []
         self._delayers: list[Delayer] = []
         self._metrics = metrics
+        # causal tracing plane: when a recorder is attached, every
+        # delivery of a journey-joinable message type (PROPAGATE / 3PC
+        # waves / catchup slices) stamps virtual-clock ``net.send`` /
+        # ``net.recv`` marks — the delayer-added latency is measured,
+        # not modeled, because the recv mark fires at the actual
+        # delivery instant. ``trace_receivers`` caps the stamped
+        # fan-out to deliveries INTO the first K peers (0 = all): at
+        # n=64 the 3PC waves are O(n^2) messages per batch and a
+        # sampled receiver set keeps the ring representative without
+        # drowning it.
+        from ..observability.trace import NULL_TRACE
+
+        self._trace = trace if trace is not None else NULL_TRACE
+        self._trace_receivers = trace_receivers
+        self._net_seq = 0
         self.dropped = 0
         self.sent = 0
         self.duplicated = 0
@@ -68,6 +86,7 @@ class SimNetwork:
     def create_peer(self, name: str) -> ExternalBus:
         bus = ExternalBus(self._make_send_handler(name))
         self._peers[name] = bus
+        self._peer_order.append(name)
         return bus
 
     def connect_all(self) -> None:
@@ -116,18 +135,38 @@ class SimNetwork:
 
         return send
 
-    def _count_drop(self, msg) -> None:
+    def _count_drop(self, msg, frm: str = "", to: str = "") -> None:
         self.dropped += 1
         self.dropped_by_type[type(msg).__name__] += 1
         if self._metrics is not None:
             self._metrics.add_event(MetricsName.SIM_NET_DROPPED)
+        if self._trace.enabled:
+            key = self._net_key(msg, to)
+            if key is not None:
+                self._trace.record(
+                    "net.drop", cat="net", node=to, key=key,
+                    args={"m": getattr(type(msg), "typename",
+                                       type(msg).__name__),
+                          "frm": frm})
+
+    def _net_key(self, msg, to: str) -> Optional[tuple]:
+        """Journey-join key for a traced delivery, or None when this
+        delivery is not stamped (untraced type, backup instance, or a
+        receiver outside the sampled set)."""
+        op = getattr(type(msg), "typename", type(msg).__name__)
+        if op not in NET_TRACED_OPS:
+            return None
+        cap = self._trace_receivers
+        if cap > 0 and to not in self._peer_order[:cap]:
+            return None
+        return net_join_key(op, lambda f: getattr(msg, f, None))
 
     def _deliver_later(self, msg, frm: str, to: str) -> None:
         if to not in self._peers:
             return
         # link must be up (receiver sees sender as connected)
         if not self._peers[to].is_connected(frm):
-            self._count_drop(msg)
+            self._count_drop(msg, frm, to)
             return
         latency = self._rng.uniform(self._min_latency, self._max_latency)
         offsets = [0.0]  # one entry per copy that will be delivered
@@ -139,7 +178,7 @@ class SimNetwork:
                 offsets = [o + e for o in offsets for e in extra]
                 continue
             if extra == float("inf"):
-                self._count_drop(msg)
+                self._count_drop(msg, frm, to)
                 return
             offsets = [o + extra for o in offsets]
         self.sent += len(offsets)
@@ -149,7 +188,32 @@ class SimNetwork:
             self._metrics.add_event(MetricsName.SIM_NET_DELIVERED,
                                     len(offsets))
         bus = self._peers[to]
+        trace_key = (self._net_key(msg, to) if self._trace.enabled
+                     else None)
+        op = getattr(type(msg), "typename", type(msg).__name__) \
+            if trace_key is not None else None
         for off in offsets:
-            self._timer.schedule(
-                latency + off,
-                lambda m=msg, f=frm, b=bus: b.process_incoming(m, f))
+            if trace_key is not None:
+                # one send/recv mark pair PER COPY (duplication chaos
+                # delivers each copy at its own instant); the recv mark
+                # fires inside the scheduled delivery so delayer-added
+                # latency lands in the measured gap
+                self._net_seq += 1
+                nid = self._net_seq
+                self._trace.record(
+                    "net.send", cat="net", node=frm, key=trace_key,
+                    args={"m": op, "to": to, "id": nid})
+                self._timer.schedule(
+                    latency + off,
+                    lambda m=msg, f=frm, b=bus, k=trace_key, i=nid,
+                    o=op, t=to: self._traced_delivery(m, f, b, k, i,
+                                                      o, t))
+            else:
+                self._timer.schedule(
+                    latency + off,
+                    lambda m=msg, f=frm, b=bus: b.process_incoming(m, f))
+
+    def _traced_delivery(self, msg, frm, bus, key, nid, op, to) -> None:
+        self._trace.record("net.recv", cat="net", node=to, key=key,
+                           args={"m": op, "frm": frm, "id": nid})
+        bus.process_incoming(msg, frm)

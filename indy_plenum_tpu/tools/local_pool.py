@@ -191,6 +191,10 @@ def build_node(directory: str, name: str, looper: Looper,
     # committed NODE txns rewire the transport (KIT semantics): new
     # members get connected, departed ones dropped, rotated keys restart
     node.on_membership_changed_hook = net.membership_hook
+    # causal tracing plane: the transport stamps net.send/net.recv marks
+    # (and piggybacks the ~trc context on the envelope) on the node's
+    # recorder — NULL_TRACE unless config.TraceRecorderEnabled
+    stack.trace = node.trace
 
     # the client-facing listener (reference: the node's client stack)
     from ..network.client_stack import ClientZStack, NodeClientSurface

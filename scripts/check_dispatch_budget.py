@@ -75,8 +75,22 @@ dispatches/ordered-batch + bytes/readback must sit within
 pipelined readbacks may move work between chips, never change or
 inflate it).
 
+Latency gate (PR 12): unless ``--no-latency-gate``, the script runs the
+n=16/k=6 workload traced TWICE on the SAME seed and fails if the causal
+journey tables (observability.causal) are not byte-identical
+(``journey_hash``), if any ordered request's journey is incomplete
+(orphan spans — every ingress must join a finalisation, batch, ordering
+and execution across the pool), if the traced ordered digests diverge
+from the untraced run, or if e2e p99 (client ingress -> executed,
+virtual protocol time) exceeds ``--e2e-budget``.
+
+Running one gate: ``--only latency`` (or ``--only trace,latency``)
+replaces stacking eight ``--no-*-gate`` flags; ``--list-gates`` prints
+the names.
+
 Usage:
     python scripts/check_dispatch_budget.py                # defaults
+    python scripts/check_dispatch_budget.py --only latency
     python scripts/check_dispatch_budget.py --nodes 16 --instances 6 \
         --budget-per-batch 40 --json
 """
@@ -219,6 +233,20 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
     if trace:
         result["trace_events"] = len(pool.trace)
         result["trace_hash"] = pool.trace.trace_hash()
+        # causal request journeys (latency gate): counts + completeness
+        # + the byte-stable journey table fingerprint + client-observed
+        # e2e percentiles with attribution shares
+        from indy_plenum_tpu.observability.causal import journey_summary
+
+        js = journey_summary(pool.trace.events())
+        result["journeys"] = {
+            "count": js["count"],
+            "complete": js["complete"],
+            "orphan_spans": js["orphan_spans"],
+            "journey_hash": js["journey_hash"],
+            "e2e": js["e2e"]["write"],
+            "attribution_share": js["attribution_share"],
+        }
     return result
 
 
@@ -777,6 +805,92 @@ def catchup_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def latency_gate(args, traced: "dict | None" = None,
+                 base: "dict | None" = None) -> "tuple[dict, list]":
+    """End-to-end latency gate (causal tracing plane, ISSUE 12): on the
+    SAME n=16/k=6 workload and seed,
+
+    1. two traced runs must produce BYTE-IDENTICAL journey tables
+       (``journey_hash``) — the causal plane is deterministic like
+       everything else in this repo;
+    2. 100% of ordered requests must yield COMPLETE journeys (no orphan
+       spans: every ingress joins a finalisation, a batch, an ordering
+       and an execution across the pool);
+    3. the traced run's ordered digests must match the untraced run's
+       bit-for-bit (tracing never perturbs consensus — shared with the
+       tracing gate, re-asserted here because this gate can run alone
+       via ``--only latency``);
+    4. e2e p99 (client ingress -> executed, VIRTUAL protocol time) is
+       recorded against ``--e2e-budget`` and fails the gate when over.
+
+    ``traced``/``base`` reuse the tracing gate's runs (identical
+    arguments) so the default full-script invocation pays only ONE
+    extra traced run (the byte-identity replay)."""
+    if traced is None:
+        traced = measure(args.sharded_nodes, args.sharded_instances,
+                         args.batches, args.batch_size, args.tick,
+                         seed=args.seed, trace=True)
+    replay = measure(args.sharded_nodes, args.sharded_instances,
+                     args.batches, args.batch_size, args.tick,
+                     seed=args.seed, trace=True)
+    if base is None:
+        base = measure(args.sharded_nodes, args.sharded_instances,
+                       args.batches, args.batch_size, args.tick,
+                       seed=args.seed)
+    failures = []
+    j, j2 = traced["journeys"], replay["journeys"]
+    if j["journey_hash"] != j2["journey_hash"]:
+        failures.append(
+            "journey tables diverge across identical seeded runs "
+            f"({j['journey_hash'][:12]} vs {j2['journey_hash'][:12]})")
+    if j["orphan_spans"] > 0 or j["complete"] != j["count"]:
+        failures.append(
+            f"{j['orphan_spans']} ordered requests left orphan spans "
+            f"({j['complete']}/{j['count']} journeys complete)")
+    if j["count"] < traced["txns_ordered"]:
+        failures.append(
+            f"journey table covers {j['count']} of "
+            f"{traced['txns_ordered']} ordered requests")
+    if traced["ordered_hash"] != base["ordered_hash"]:
+        failures.append("traced ordered digests diverge from the "
+                        "untraced run (journey marks perturbed "
+                        "consensus)")
+    p99 = j["e2e"]["p99"]
+    if p99 > args.e2e_budget:
+        failures.append(f"e2e p99 {p99} sim-seconds over budget "
+                        f"{args.e2e_budget}")
+    record = {
+        "traced": traced,
+        "replay_journey_hash": j2["journey_hash"],
+        "journeys_deterministic":
+            j["journey_hash"] == j2["journey_hash"],
+        "digests_match": traced["ordered_hash"] == base["ordered_hash"],
+        "e2e": j["e2e"],
+        "e2e_budget": args.e2e_budget,
+        "attribution_share": j["attribution_share"],
+    }
+    return record, failures
+
+
+# gate registry (--list-gates / --only): name -> (argparse dest of the
+# skip flag, one-line description). The core dispatch-budget measurement
+# always runs — it is the baseline every budget compares against.
+GATES = {
+    "governor": ("no_governor_gates",
+                 "bursty static-vs-adaptive tick comparison"),
+    "sharded": ("no_sharded_gate", "1-device vs mesh-sharded identity"),
+    "fabric": ("no_fabric_gate", "1-axis vs 2-axis quorum fabric"),
+    "trace": ("no_trace_gate", "flight-recorder overhead + identity"),
+    "readback": ("no_readback_gate", "device-eval vs host-eval readback"),
+    "ingress": ("no_ingress_gate", "open-loop saturation/admission"),
+    "proof": ("no_proof_gate", "state-proof plane (BLS, zero pairings)"),
+    "catchup": ("no_catchup_gate", "chaos-hardened catchup recovery"),
+    "latency": ("no_latency_gate",
+                "causal journeys: byte-identical tables, zero orphans, "
+                "e2e p99 budget"),
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--nodes", type=int, default=4)
@@ -812,6 +926,21 @@ def main() -> int:
                          "(GC-crossing crash/restart verdicts, ledger "
                          "bit-identity, byte-identical replay, byzantine "
                          "seeder rejection)")
+    ap.add_argument("--no-latency-gate", action="store_true",
+                    help="skip the causal-journey latency gate "
+                         "(byte-identical journey tables, zero orphan "
+                         "spans, traced-vs-untraced ordered_hash, e2e "
+                         "p99 budget)")
+    ap.add_argument("--only", default=None, metavar="GATE[,GATE]",
+                    help="run ONLY the named gate(s) — e.g. '--only "
+                         "latency' instead of stacking eight --no-*-gate "
+                         "flags; see --list-gates for names. The core "
+                         "dispatch-budget measurement always runs")
+    ap.add_argument("--list-gates", action="store_true",
+                    help="print the gate names --only accepts and exit")
+    ap.add_argument("--e2e-budget", type=float, default=5.0,
+                    help="max e2e p99 (client ingress -> executed, "
+                         "VIRTUAL sim-seconds) the latency gate accepts")
     ap.add_argument("--proof-speedup-floor", type=float, default=2.0,
                     help="min batch-64 multi-sig verify speedup vs the "
                          "per-root path")
@@ -865,6 +994,20 @@ def main() -> int:
                     help="emit the measurement as one JSON line")
     args = ap.parse_args()
 
+    if args.list_gates:
+        for name, (_dest, desc) in GATES.items():
+            print(f"{name:10s} {desc}")
+        return 0
+    if args.only is not None:
+        chosen = [g.strip() for g in args.only.split(",") if g.strip()]
+        unknown = [g for g in chosen if g not in GATES]
+        if unknown:
+            raise SystemExit(
+                f"--only: unknown gate(s) {', '.join(unknown)} "
+                f"(see --list-gates)")
+        for name, (dest, _desc) in GATES.items():
+            setattr(args, dest, name not in chosen)
+
     result = measure(args.nodes, args.instances, args.batches,
                      args.batch_size, args.tick, seed=args.seed)
     per_batch = result["device_dispatches_per_ordered_batch"]
@@ -895,9 +1038,17 @@ def main() -> int:
         record, failures = fabric_gate(args, base=sharded_mesh)
         result["fabric_gate"] = record
         over.extend(failures)
+    traced_run = None
     if not args.no_trace_gate:
         record, failures = tracing_gate(args, base=sharded_single)
         result["tracing_gate"] = record
+        over.extend(failures)
+        # same args as the latency gate's first traced arm — reuse it
+        traced_run = record.get("traced")
+    if not args.no_latency_gate:
+        record, failures = latency_gate(args, traced=traced_run,
+                                        base=sharded_single)
+        result["latency_gate"] = record
         over.extend(failures)
     if not args.no_readback_gate:
         record, failures = readback_gate(args, base=sharded_single)

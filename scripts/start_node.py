@@ -41,6 +41,11 @@ def main() -> int:
 
     warm_device_auth_path()
     node.start()
+    # operator flight dump: `kill -USR2 <pid>` snapshots the trace ring
+    # (flight.signal mark) and writes <logs>/<name>.flight.jsonl without
+    # stopping the node — only the process entry point installs handlers
+    node.install_signal_handlers(
+        dump_dir=os.path.join(directory, "logs"))
     looper.add(stack)
     looper.add(node.client_surface)
     print(f"{name} listening on {stack.ha[0]}:{stack.ha[1]} "

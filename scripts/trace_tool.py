@@ -4,13 +4,18 @@ Usage:
     python scripts/trace_tool.py TRACE.jsonl                 # full report
     python scripts/trace_tool.py TRACE.jsonl --phases        # percentiles
     python scripts/trace_tool.py TRACE.jsonl --critical-path
+    python scripts/trace_tool.py TRACE.jsonl --journeys      # e2e table
+    python scripts/trace_tool.py TRACE.jsonl --journey DIGEST
     python scripts/trace_tool.py TRACE.jsonl --chrome OUT.json
     python scripts/trace_tool.py TRACE.jsonl --json
     python scripts/trace_tool.py TRACE.jsonl --node node0
+    python scripts/trace_tool.py n0.jsonl n1.jsonl n2.jsonl --journeys
 
 Dumps come from ``SimPool(trace=True)`` / ``NodePool(trace=True)``,
-``chaos_run.py --trace`` (``<report>.trace.jsonl``), or
-``profile_rbft.py --trace``. Three views:
+``chaos_run.py --trace`` (``<report>.trace.jsonl``),
+``profile_rbft.py --trace``, or a deployed node's SIGUSR2 flight dump.
+Several dumps (one per node) merge into one deterministic timeline —
+the causal plane's cross-node joins work either way. Views:
 
 - **--phases**: per-phase latency percentiles (p50/p90/p99/max) for the
   3PC lifecycle — prepare / commit / order / execute, plus the ingress
@@ -19,8 +24,16 @@ Dumps come from ``SimPool(trace=True)`` / ``NodePool(trace=True)``,
 - **--critical-path**: per ordered batch, which phase dominated its
   latency, plus each phase's share of total attributed time — the view
   that turns "a batch ordered in X ms" into "X went to the prepare wave".
+- **--journeys**: the causal journey table (observability.causal) —
+  per-request end-to-end latency ACROSS NODES with network / queue /
+  compute / device attribution, completeness, and the byte-stable
+  ``journey_hash``.
+- **--journey DIGEST** (prefix ok): one request's full cross-node path —
+  every per-node lifecycle mark with its deterministic span id, per-hop
+  attribution, and the per-wave network latency samples behind it.
 - **--chrome**: Chrome trace-event JSON (one pid per node, one tid per
-  category), loadable in Perfetto (https://ui.perfetto.dev) or
+  category; matched net.send/net.recv marks become flow arrows between
+  node tracks), loadable in Perfetto (https://ui.perfetto.dev) or
   chrome://tracing.
 
 Deliberately free of jax imports: the tool must run anywhere a dump
@@ -34,6 +47,12 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from indy_plenum_tpu.observability.causal import (  # noqa: E402
+    build_journeys,
+    journey_for,
+    journey_summary,
+    merge_events,
+)
 from indy_plenum_tpu.observability.trace import (  # noqa: E402
     critical_path,
     load_jsonl,
@@ -55,9 +74,78 @@ def _flight_events(events) -> list:
     return [ev for ev in events if ev.get("cat") == "flight"]
 
 
+def _print_journey(detail: dict) -> None:
+    j = detail["journey"]
+    print(f"journey {j['digest'][:16]}… trace_id={j['trace_id']} "
+          f"class={j['class']} batch=(v{j['batch'][0]} "
+          f"s{j['batch'][1]} {str(j['batch'][2])[:12]}…)")
+    print(f"  e2e={j['e2e']} complete={j['complete']} "
+          f"attribution={j['attribution']}"
+          + (f" via_catchup={j['catchup']}" if j.get("catchup") else "")
+          + (f" proof_after={j['proof_after']}"
+             if "proof_after" in j else ""))
+    print(f"  {'hop':12s} {'t0':>16s} {'dur':>12s} {'network':>10s} "
+          f"{'residual':>16s} span_id")
+    for h in j["hops"]:
+        residual = next(((k, v) for k, v in h.items()
+                         if k in ("queue", "compute", "device")),
+                        ("", 0.0))
+        print(f"  {h['hop']:12s} {h['t0']:>16.6f} {h['dur']:>12.6f} "
+              f"{h['network']:>10.6f} {residual[1]:>10.6f} "
+              f"{residual[0]:<5s} {h['span_id']}")
+    print("  cross-node marks:")
+    for m in detail["marks"]:
+        print(f"    t={m['ts']:.6f} {m['node'] or 'pool':10s} "
+              f"{m['name']:22s} span={m['span_id']}")
+    if detail["net_waves"]:
+        print("  network waves (in-flight seconds per delivered copy):")
+        for op, lats in detail["net_waves"].items():
+            show = ", ".join(f"{v:.4f}" for v in lats[:8])
+            more = f" (+{len(lats) - 8} more)" if len(lats) > 8 else ""
+            print(f"    {op:12s} n={len(lats):<4d} {show}{more}")
+
+
+def _print_journey_table(record: dict) -> None:
+    js = record["journeys"]
+    e2e_w, e2e_r = js["e2e"]["write"], js["e2e"]["read"]
+    print(f"journeys: {js['complete']}/{js['count']} complete "
+          f"(orphans={js['orphan_spans']}, pending={js['pending']}, "
+          f"shed={js['shed']}, via_catchup={js['catchup_journeys']}) "
+          f"hash={js['journey_hash'][:16]}…")
+    print(f"  e2e write: n={e2e_w['count']} p50={e2e_w['p50']} "
+          f"p90={e2e_w['p90']} p99={e2e_w['p99']} max={e2e_w['max']}")
+    if e2e_r["count"]:
+        print(f"  e2e read:  n={e2e_r['count']} p50={e2e_r['p50']} "
+              f"p90={e2e_r['p90']} p99={e2e_r['p99']}")
+    if js["attribution_share"]:
+        print("  attribution: " + "  ".join(
+            f"{k}={v:.1%}" for k, v in js["attribution_share"].items()))
+    if js.get("critical_path"):
+        print("  dominant hop: " + "  ".join(
+            f"{k}={v}" for k, v in js["critical_path"].items()))
+    fw = js.get("fault_window")
+    if fw:
+        print(f"  fault windows: {fw['windows']} — "
+              f"{fw['through_fault']['count']} journeys crossed one "
+              f"(p50 {fw['through_fault']['p50']} vs "
+              f"{fw['clear']['p50']} clear, p50_cost={fw['p50_cost']})")
+    for j in record.get("journey_table", []):
+        mark = "" if j["complete"] else "  INCOMPLETE"
+        catchup = (" catchup=" + ",".join(j["catchup"])
+                   if j.get("catchup") else "")
+        print(f"  {j['digest'][:16]}… e2e={j['e2e']} "
+              f"batch=v{j['batch'][0]}s{j['batch'][1]} "
+              f"net={j['attribution']['network']} "
+              f"queue={j['attribution']['queue']} "
+              f"compute={j['attribution']['compute']} "
+              f"device={j['attribution']['device']}{catchup}{mark}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("dump", help="trace JSONL file")
+    ap.add_argument("dump", nargs="+",
+                    help="trace JSONL file(s); several per-node dumps "
+                         "merge into one deterministic timeline")
     ap.add_argument("--phases", action="store_true",
                     help="per-phase latency percentiles only")
     ap.add_argument("--critical-path", action="store_true",
@@ -65,6 +153,14 @@ def main() -> int:
     ap.add_argument("--overlap", action="store_true",
                     help="per-tick host/device overlap fraction + "
                          "readback-bytes column (ordering fast path)")
+    ap.add_argument("--journeys", action="store_true",
+                    help="causal journey table: per-request cross-node "
+                         "e2e latency with network/queue/compute/device "
+                         "attribution + journey_hash")
+    ap.add_argument("--journey", metavar="DIGEST", default=None,
+                    help="one request's full cross-node path (digest "
+                         "prefix ok): per-node marks, span ids, per-hop "
+                         "attribution, per-wave network samples")
     ap.add_argument("--chrome", metavar="OUT",
                     help="write Chrome trace-event JSON (Perfetto)")
     ap.add_argument("--node", default=None,
@@ -73,21 +169,43 @@ def main() -> int:
                     help="one machine-readable JSON line on stdout")
     args = ap.parse_args()
 
-    events = load_jsonl(args.dump)
+    if len(args.dump) == 1:
+        events = load_jsonl(args.dump[0])
+    else:
+        events = merge_events(*[load_jsonl(p) for p in args.dump])
     if not events:
-        print(f"{args.dump}: no events", file=sys.stderr)
+        print(f"{', '.join(args.dump)}: no events", file=sys.stderr)
         return 2
 
-    record = {"dump": args.dump, "summary": _counts(events)}
-    # --phases/--critical-path/--overlap narrow the view; --chrome is
-    # orthogonal
-    view_selected = args.phases or args.critical_path or args.overlap
+    record = {"dump": args.dump[0] if len(args.dump) == 1
+              else list(args.dump), "summary": _counts(events)}
+    # --phases/--critical-path/--overlap/--journeys narrow the view;
+    # --chrome is orthogonal, --journey replaces the report entirely
+    if args.journey is not None:
+        detail = journey_for(events, args.journey)
+        if detail is None:
+            print(f"no journey matches digest prefix {args.journey!r}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(detail, separators=(",", ":"),
+                             sort_keys=True))
+            return 0
+        _print_journey(detail)
+        return 0
+    view_selected = (args.phases or args.critical_path or args.overlap
+                     or args.journeys)
     if args.phases or not view_selected:
         record["phase_latency"] = phase_percentiles(events, node=args.node)
     if args.critical_path or not view_selected:
         record["critical_path"] = critical_path(events, node=args.node)
     if args.overlap or not view_selected:
         record["overlap"] = overlap_report(events, node=args.node)
+    if args.journeys or not view_selected:
+        built = build_journeys(events)
+        record["journeys"] = journey_summary(events, built=built)
+        if args.journeys:
+            record["journey_table"] = built["journeys"]
     if not view_selected:
         record["flight_events"] = _flight_events(events)
     if args.chrome:
@@ -102,7 +220,7 @@ def main() -> int:
         return 0
 
     summary = record["summary"]
-    print(f"{args.dump}: {summary['events']} events "
+    print(f"{', '.join(args.dump)}: {summary['events']} events "
           f"({', '.join(f'{c}={n}' for c, n in sorted(summary['by_cat'].items()))})")
     if "phase_latency" in record:
         print("phase latency (p50/p90/p99/max, trace clock units):")
@@ -145,6 +263,8 @@ def main() -> int:
             for c, (v, sh) in enumerate(zip(ps["votes"],
                                             ps["vote_share"])):
                 print(f"  {c:>12d} {v:>9d} {sh:>9.2%}")
+    if "journeys" in record:
+        _print_journey_table(record)
     if record.get("flight_events"):
         print("flight events:")
         for ev in record["flight_events"]:

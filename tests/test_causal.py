@@ -1,0 +1,488 @@
+"""Causal request journeys (observability.causal): deterministic
+cross-node joins, network/queue/compute attribution, multi-dump merges,
+the strict NULL_TRACE cost contract, and the SIGUSR2 flight dump.
+
+The determinism contract under test is the latency gate's: a seeded
+virtual-clock run produces a BYTE-identical journey table
+(``journey_hash``), every ordered request yields a COMPLETE journey (no
+orphan spans), and tracing never perturbs consensus.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.observability.causal import (
+    build_journeys,
+    journey_for,
+    journey_hash,
+    journey_summary,
+    merge_events,
+    span_id,
+    trace_id,
+)
+from indy_plenum_tpu.observability.trace import (
+    NullTraceRecorder,
+    events_to_jsonl,
+    to_chrome_trace,
+)
+from indy_plenum_tpu.simulation.pool import SimPool
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# pure-function identities
+# ----------------------------------------------------------------------
+
+def test_trace_and_span_ids_are_pure_functions():
+    d = "ab" * 32
+    assert trace_id(d) == trace_id(d)
+    assert len(trace_id(d)) == 16
+    tid = trace_id(d)
+    assert span_id(tid, "node0", "prepare") \
+        == span_id(tid, "node0", "prepare")
+    # node and hop both contribute: two nodes' spans never collide
+    assert span_id(tid, "node0", "prepare") \
+        != span_id(tid, "node1", "prepare")
+    assert span_id(tid, "node0", "prepare") \
+        != span_id(tid, "node0", "commit")
+    assert trace_id("cd" * 32) != tid
+
+
+# ----------------------------------------------------------------------
+# synthetic journeys: joins + attribution semantics
+# ----------------------------------------------------------------------
+
+def _mk(ts, name, cat="3pc", node="", key=None, args=None, seq=0):
+    ev = {"seq": seq, "ts": ts, "name": name, "cat": cat}
+    if node:
+        ev["node"] = node
+    if key is not None:
+        ev["key"] = list(key)
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _synthetic_journey_events():
+    """One request's full pool journey: ingress at t=0 on node0, a
+    100ms batching wait, a 3PC wave with 10ms network hops, executed at
+    t=0.35 — every number below is asserted."""
+    d = "req-digest-1"
+    bd = "batch-digest-1"
+    bk = (0, 1, bd)
+    evs = [
+        _mk(0.00, "req.ingress", "req", key=(d,),
+            args={"rid": "client|1"}),
+        _mk(0.02, "req.admitted", "req", key=(d,)),
+        _mk(0.05, "req.finalised", "req", key=(d,)),
+        _mk(0.15, "3pc.preprepare_sent", node="node0", key=bk,
+            args={"reqs": 1, "reqIdr": [d]}),
+        # PREPREPARE wave: node0 -> node1, 10ms in flight
+        _mk(0.15, "net.send", "net", node="node0", key=(0, 1),
+            args={"m": "PREPREPARE", "to": "node1", "id": 1}),
+        _mk(0.16, "net.recv", "net", node="node1", key=(0, 1),
+            args={"m": "PREPREPARE", "frm": "node0", "id": 1}),
+        _mk(0.16, "3pc.preprepare", node="node1", key=bk),
+        _mk(0.17, "net.send", "net", node="node1", key=(0, 1),
+            args={"m": "PREPARE", "to": "node2", "id": 2}),
+        _mk(0.18, "net.recv", "net", node="node2", key=(0, 1),
+            args={"m": "PREPARE", "frm": "node1", "id": 2}),
+        _mk(0.21, "3pc.prepare_quorum", node="node1", key=bk),
+        _mk(0.26, "3pc.commit_quorum", node="node1", key=bk),
+        _mk(0.30, "3pc.ordered", node="node1", key=bk),
+        _mk(0.35, "3pc.executed", node="node1", key=bk),
+        _mk(0.36, "3pc.executed", node="node0", key=bk),
+    ]
+    for i, ev in enumerate(evs):
+        ev["seq"] = i + 1
+    return evs
+
+
+def test_synthetic_journey_phases_and_attribution():
+    built = build_journeys(_synthetic_journey_events())
+    assert len(built["journeys"]) == 1
+    j = built["journeys"][0]
+    assert j["complete"]
+    assert j["digest"] == "req-digest-1"
+    assert j["batch"] == [0, 1, "batch-digest-1"]
+    assert j["e2e"] == pytest.approx(0.35)
+    hops = {h["hop"]: h for h in j["hops"]}
+    assert hops["admission"]["dur"] == pytest.approx(0.02)
+    assert hops["auth"]["dur"] == pytest.approx(0.03)
+    assert hops["batching"]["dur"] == pytest.approx(0.10)
+    # preprepare hop: 10ms wall, all of it measured in flight
+    assert hops["preprepare"]["dur"] == pytest.approx(0.01)
+    assert hops["preprepare"]["network"] == pytest.approx(0.01)
+    # prepare hop: 50ms wall, 10ms of it the PREPARE wave's transit
+    assert hops["prepare"]["dur"] == pytest.approx(0.05)
+    assert hops["prepare"]["network"] == pytest.approx(0.01)
+    assert hops["prepare"]["queue"] == pytest.approx(0.04)
+    assert hops["execute"]["compute"] == pytest.approx(0.05)
+    # attribution buckets cover the whole journey
+    total = sum(j["attribution"].values())
+    assert total == pytest.approx(j["e2e"], abs=1e-9)
+    # earliest executed anywhere ends the journey (0.35, not 0.36)
+    assert j["attribution"]["network"] == pytest.approx(0.02)
+
+
+def test_orphan_and_pending_detection():
+    evs = _synthetic_journey_events()
+    # a second request that got ingressed but never ordered: pending
+    evs.append(_mk(0.4, "req.ingress", "req", key=("req-digest-2",),
+                   seq=99))
+    # a third that was shed
+    evs.append(_mk(0.5, "req.ingress", "req", key=("req-digest-3",),
+                   seq=100))
+    evs.append(_mk(0.6, "req.shed", "req", key=("req-digest-3",),
+                   seq=101))
+    built = build_journeys(evs)
+    summ = journey_summary(evs, built=built)
+    assert summ["count"] == 1 and summ["complete"] == 1
+    assert summ["orphan_spans"] == 0
+    assert summ["pending"] == 1 and summ["shed"] == 1
+    # drop the ingress mark: the ordered request's journey survives but
+    # is INCOMPLETE — an orphan span the latency gate fails on
+    evs2 = [e for e in _synthetic_journey_events()
+            if e["name"] != "req.ingress"]
+    summ2 = journey_summary(evs2)
+    assert summ2["count"] == 1 and summ2["complete"] == 0
+    assert summ2["orphan_spans"] == 1
+
+
+def test_journey_hash_is_byte_stable_and_input_sensitive():
+    evs = _synthetic_journey_events()
+    j1 = build_journeys(evs)["journeys"]
+    j2 = build_journeys(list(evs))["journeys"]
+    assert journey_hash(j1) == journey_hash(j2)
+    moved = [dict(e) for e in evs]
+    moved[-2] = dict(moved[-2], ts=0.33)  # executed earlier
+    assert journey_hash(build_journeys(moved)["journeys"]) \
+        != journey_hash(j1)
+
+
+def test_merge_events_joins_per_node_dumps():
+    """Split the synthetic pool timeline into per-node dumps (what N
+    deployed nodes would each produce) — the merged journey must be
+    identical to the pool-shared one."""
+    evs = _synthetic_journey_events()
+    by_node = {}
+    for ev in evs:
+        by_node.setdefault(ev.get("node", ""), []).append(ev)
+    assert len(by_node) >= 3
+    merged = merge_events(*by_node.values())
+    assert journey_hash(build_journeys(merged)["journeys"]) \
+        == journey_hash(build_journeys(evs)["journeys"])
+
+
+def test_fault_window_cost_attribution():
+    """A journey overlapping a chaos fault window lands in the
+    through_fault bucket and shows the fault's p50 latency cost."""
+    evs = _synthetic_journey_events()
+    # a fault live during the whole journey
+    evs.insert(0, _mk(0.0, "begin slow_links", "chaos", seq=0))
+    evs.append(_mk(0.5, "end slow_links", "chaos", seq=102))
+    summ = journey_summary(evs)
+    assert summ["fault_window"]["windows"] == 1
+    assert summ["fault_window"]["through_fault"]["count"] == 1
+    assert summ["fault_window"]["clear"]["count"] == 0
+
+
+def test_read_journeys_pair_fifo():
+    evs = [
+        _mk(0.0, "read.submitted", "read", seq=1),
+        _mk(0.0, "read.submitted", "read", seq=2),
+        _mk(0.2, "read.served", "read", args={"n": 2}, seq=3),
+    ]
+    built = build_journeys(evs)
+    assert built["read_e2e"] == [pytest.approx(0.2)] * 2
+    summ = journey_summary(evs, built=built)
+    assert summ["e2e"]["read"]["count"] == 2
+    assert summ["e2e"]["read"]["p50"] == pytest.approx(0.2)
+
+
+def test_chrome_flow_events_arc_between_node_pids():
+    chrome = to_chrome_trace(_synthetic_journey_events())
+    flows = [e for e in chrome["traceEvents"] if e["ph"] in ("s", "f")]
+    assert len(flows) == 4  # two matched send/recv pairs
+    by_id = {}
+    for f in flows:
+        by_id.setdefault(f["id"], []).append(f)
+    for fid, pair in by_id.items():
+        phs = {f["ph"] for f in pair}
+        assert phs == {"s", "f"}
+        # the arc crosses pids (sender != receiver track)
+        assert len({f["pid"] for f in pair}) == 2
+
+
+# ----------------------------------------------------------------------
+# pool integration
+# ----------------------------------------------------------------------
+
+def _run_pool(seed, n=4, txns=20, device=False):
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+        **({"QuorumTickInterval": 0.05, "QuorumTickAdaptive": True}
+           if device else {})})
+    pool = SimPool(n_nodes=n, seed=seed, config=config,
+                   device_quorum=device, shadow_check=False,
+                   trace=True)
+    for i in range(txns):
+        pool.submit_request(i)
+    for _ in range(60):
+        pool.run_for(0.5)
+        if min(len(nd.ordered_digests) for nd in pool.nodes) >= txns:
+            break
+    assert pool.honest_nodes_agree()
+    assert min(len(nd.ordered_digests) for nd in pool.nodes) >= txns
+    return pool
+
+
+def test_simpool_journeys_complete_and_deterministic():
+    p1, p2 = _run_pool(31), _run_pool(31)
+    s1 = journey_summary(p1.trace.events())
+    s2 = journey_summary(p2.trace.events())
+    assert s1["count"] == 20
+    assert s1["complete"] == 20 and s1["orphan_spans"] == 0
+    assert s1["journey_hash"] == s2["journey_hash"]
+    # network attribution is real: the sim's 10-50ms link latency shows
+    assert s1["attribution_share"].get("network", 0) > 0
+    # every journey names its batch and carries per-hop spans
+    j = build_journeys(p1.trace.events())["journeys"][0]
+    assert j["batch"][2] and len(j["hops"]) >= 5
+    assert all("span_id" in h for h in j["hops"])
+
+
+def test_device_tick_pool_journeys_complete():
+    pool = _run_pool(17, device=True)
+    summ = journey_summary(pool.trace.events())
+    assert summ["count"] == 20
+    assert summ["complete"] == 20 and summ["orphan_spans"] == 0
+    # tick-batched dispatch: the order hop's residual charges to the
+    # device bucket (dump-derived, no out-of-band mode flag)
+    assert "device" in summ["attribution_share"]
+
+
+def test_monitor_snapshot_e2e_block():
+    """NodePool: Monitor.snapshot() reports the pool-rollup e2e block
+    (journeys joined across real Node compositions, PROPAGATE included)."""
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    pool = NodePool(n_nodes=4, seed=5, trace=True)
+    client = pool.make_client()
+    for i in range(6):
+        pool.submit_to("node0", pool.make_nym_request(i + 1))
+    pool.run_for(15)
+    assert pool.honest_nodes_agree()
+    snap = pool.nodes[0].monitor.snapshot()
+    blk = snap.get("e2e_latency")
+    assert blk is not None
+    assert blk["write"]["count"] >= 6
+    assert blk["orphan_spans"] == 0
+    assert blk["journey_hash"]
+    # the PROPAGATE fan-out was stamped on the wire and joined
+    ops = {(e.get("args") or {}).get("m")
+           for e in pool.trace.events() if e.get("cat") == "net"}
+    assert "PROPAGATE" in ops and "PREPARE" in ops
+    del client
+
+
+# ----------------------------------------------------------------------
+# NULL_TRACE strict cost contract (satellite: guard audit)
+# ----------------------------------------------------------------------
+
+class _StrictNullTrace(NullTraceRecorder):
+    """A disabled recorder that COUNTS every call reaching it: guarded
+    call sites never invoke the recorder at all when disabled, so any
+    nonzero count is an unguarded site building args for nothing."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def record(self, name, cat="3pc", node="", key=None, dur=None,
+               args=None, ts=None):
+        self.calls.append(("record", name, args))
+
+    def span(self, name, cat="dispatch", node="", args=None):
+        self.calls.append(("span", name, args))
+        return super().span(name, cat=cat, node=node, args=args)
+
+    def trigger_dump(self, reason, node="", args=None):
+        self.calls.append(("trigger_dump", reason, args))
+        return super().trigger_dump(reason, node=node, args=args)
+
+
+def test_disabled_trace_call_sites_build_nothing(monkeypatch):
+    """Audit-as-test: with tracing disabled, NO call site — 3PC, ingress
+    shed, catchup, proofs, transports, dispatch plane — may reach the
+    recorder (arg construction is guarded on trace.enabled everywhere)."""
+    import indy_plenum_tpu.observability.trace as trace_mod
+
+    spy = _StrictNullTrace()
+    monkeypatch.setattr(trace_mod, "NULL_TRACE", spy)
+    config = getConfig({
+        "Max3PCBatchSize": 5, "Max3PCBatchWait": 0.1,
+        "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True,
+        "IngressQueueCapacity": 4, "CHK_FREQ": 5, "LOG_SIZE": 15})
+    pool = SimPool(n_nodes=4, seed=3, config=config, device_quorum=True,
+                   shadow_check=False, sign_requests=True,
+                   real_execution=True, trace=False)
+    assert pool.trace is spy
+    # overload the 4-slot queue so the shed path runs too
+    for i in range(30):
+        pool.submit_request(i, client_id="c%d" % (i % 3))
+    pool.run_for(12)
+    rs = pool.make_read_service("node0", mode="host")
+    rs.submit(0)
+    rs.drain()
+    assert spy.calls == []
+
+
+# ----------------------------------------------------------------------
+# SIGUSR2 flight dump (satellite: deployed-node operator snapshot)
+# ----------------------------------------------------------------------
+
+def test_sigusr2_installs_only_on_request_and_dumps(tmp_path):
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    before = signal.getsignal(signal.SIGUSR2)
+    try:
+        pool = NodePool(n_nodes=4, seed=9, trace=True)
+        # pool composition must NOT have touched process signal state
+        assert signal.getsignal(signal.SIGUSR2) is before
+        pool.submit_to("node0", pool.make_nym_request(1))
+        pool.run_for(5)
+        node = pool.nodes[0]
+        assert node.install_signal_handlers(dump_dir=str(tmp_path))
+        os.kill(os.getpid(), signal.SIGUSR2)
+        # the handler ran the existing trigger_dump path
+        assert any(d["reason"] == "signal" for d in pool.trace.dumps)
+        marks = [e for e in pool.trace.events()
+                 if e["name"] == "flight.signal"]
+        assert marks and marks[0]["node"] == "node0"
+        # ... and wrote the operator's JSONL dump
+        dump = tmp_path / "node0.flight.jsonl"
+        assert dump.exists() and dump.read_text().strip()
+    finally:
+        signal.signal(signal.SIGUSR2, before)
+
+
+# ----------------------------------------------------------------------
+# trace_tool surfaces
+# ----------------------------------------------------------------------
+
+def test_trace_tool_journeys_and_single_journey(tmp_path):
+    pool = _run_pool(11, txns=10)
+    dump = tmp_path / "pool.jsonl"
+    dump.write_text(pool.trace.to_jsonl())
+    tool = os.path.join(REPO_ROOT, "scripts", "trace_tool.py")
+    proc = subprocess.run(
+        [sys.executable, tool, str(dump), "--journeys", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    js = record["journeys"]
+    assert js["count"] == 10 and js["complete"] == 10
+    digest = record["journey_table"][0]["digest"]
+    # one request's cross-node path, by digest prefix
+    proc2 = subprocess.run(
+        [sys.executable, tool, str(dump), "--journey", digest[:12]],
+        capture_output=True, text=True, timeout=120)
+    assert proc2.returncode == 0, proc2.stderr
+    assert "cross-node marks" in proc2.stdout
+    assert "network waves" in proc2.stdout
+    # human-readable table
+    proc3 = subprocess.run(
+        [sys.executable, tool, str(dump), "--journeys"],
+        capture_output=True, text=True, timeout=120)
+    assert proc3.returncode == 0
+    assert "10/10 complete" in proc3.stdout
+
+
+def test_trace_tool_merges_per_node_dumps(tmp_path):
+    """N per-node dumps (a deployed pool's SIGUSR2 snapshots) merge into
+    the same journey table as the pool-shared dump."""
+    pool = _run_pool(13, txns=10)
+    events = pool.trace.events()
+    paths = []
+    for node in ("", "node0", "node1", "node2", "node3"):
+        evs = [e for e in events if e.get("node", "") == node]
+        p = tmp_path / f"{node or 'pool'}.jsonl"
+        p.write_text(events_to_jsonl(evs))
+        paths.append(str(p))
+    tool = os.path.join(REPO_ROOT, "scripts", "trace_tool.py")
+    proc = subprocess.run(
+        [sys.executable, tool, *paths, "--journeys", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["journeys"]["count"] == 10
+    assert record["journeys"]["complete"] == 10
+
+
+# ----------------------------------------------------------------------
+# slow lane: disruption coverage (view change, catchup)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_journeys_byte_identical_through_view_change():
+    """ISSUE acceptance: journey completeness + journey_hash identity
+    at n=8/k=2 through a primary-kill view change."""
+
+    def run():
+        config = getConfig({
+            "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+            "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True})
+        pool = SimPool(n_nodes=8, seed=47, config=config,
+                       device_quorum=True, shadow_check=False,
+                       num_instances=2, trace=True)
+        primary = pool.nodes[0].data.primaries[0]
+        for i in range(8):
+            pool.submit_request(i)
+        pool.run_for(8)
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 108):
+            pool.submit_request(i)
+        pool.run_for(12)
+        survivors = [n for n in pool.nodes if n.name != primary]
+        assert all(n.data.view_no >= 1 for n in survivors)
+        return pool
+
+    p1, p2 = run(), run()
+    s1 = journey_summary(p1.trace.events())
+    s2 = journey_summary(p2.trace.events())
+    assert s1["journey_hash"] == s2["journey_hash"]
+    assert s1["count"] >= 16
+    # every request ordered across the view change joined completely
+    assert s1["orphan_spans"] == 0 and s1["complete"] == s1["count"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_catchup_journeys_show_leech_not_orphan():
+    """ISSUE acceptance: through f_crash_gc_catchup, a request ordered
+    while the victim was down yields a COMPLETE journey annotated with
+    the catchup (the victim's ledger got it by leeching), never an
+    orphan — and the whole journey table replays byte-identically."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    r1 = run_scenario("f_crash_gc_catchup", seed=11, trace=True)
+    assert r1.verdict_as_expected, r1.failed
+    js = r1.journeys
+    assert js["count"] > 0
+    assert js["complete"] == js["count"] and js["orphan_spans"] == 0
+    # the GC'd window's requests ordered in the victim's absence: their
+    # journeys name the leeching node instead of dangling
+    assert js["catchup_journeys"] >= 1
+    # determinism through the whole chaos arc
+    r2 = run_scenario("f_crash_gc_catchup", seed=11, trace=True)
+    assert r2.journeys["journey_hash"] == js["journey_hash"]
+    # fault windows rode the same timeline into the cost split
+    assert js.get("fault_window", {}).get("windows", 0) >= 1

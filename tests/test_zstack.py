@@ -60,6 +60,50 @@ def test_messages_flow_and_are_attributed_by_curve_key():
         s.close()
 
 
+def test_trace_context_piggybacks_on_the_envelope():
+    """Causal tracing plane over real sockets: a traced PREPARE carries
+    the ~trc context on the wire; the sender stamps net.send, the
+    receiver strips the context before schema validation and stamps a
+    net.recv joinable by (viewNo, ppSeqNo) + flow id."""
+    from indy_plenum_tpu.common.messages.node_messages import Prepare
+    from indy_plenum_tpu.observability.trace import TraceRecorder
+
+    stacks = wire(["A", "B"])
+    try:
+        stacks["A"].trace = TraceRecorder(time.perf_counter, node="A")
+        stacks["B"].trace = TraceRecorder(time.perf_counter, node="B")
+        got = []
+        stacks["B"].on_message = lambda msg, frm: got.append((msg, frm))
+        stacks["A"].send(
+            Prepare(instId=0, viewNo=2, ppSeqNo=7, ppTime=time.time(),
+                    digest="d" * 16, stateRootHash=None,
+                    txnRootHash=None),
+            ["B"])
+        pump(list(stacks.values()), 1.5)
+        assert got, "traced message did not arrive"
+        msg, frm = got[0]
+        assert frm == "A" and msg.viewNo == 2 and msg.ppSeqNo == 7
+        sends = [e for e in stacks["A"].trace.events()
+                 if e["name"] == "net.send"]
+        recvs = [e for e in stacks["B"].trace.events()
+                 if e["name"] == "net.recv"]
+        assert len(sends) == 1 and len(recvs) == 1
+        assert sends[0]["key"] == [2, 7] == recvs[0]["key"]
+        # the flow id propagated THROUGH the wire, not via shared state
+        assert recvs[0]["args"]["id"] == sends[0]["args"]["id"]
+        # the sender's clock reading rode along (offset estimate)
+        assert recvs[0]["args"]["sent"] == pytest.approx(
+            sends[0]["ts"], abs=1e-6)
+        # untraced messages stay byte-compatible: no context injected
+        stacks["A"].trace = TraceRecorder(time.perf_counter, node="A")
+        stacks["A"].send(make_msg(), ["B"])
+        pump(list(stacks.values()), 1.5)
+        assert len(got) == 2 and isinstance(got[1][0], Checkpoint)
+    finally:
+        for s in stacks.values():
+            s.close()
+
+
 def test_unknown_curve_key_cannot_deliver():
     stacks = wire(["A", "B"])
     attacker = ZStack("evil", seed_of("evil"))

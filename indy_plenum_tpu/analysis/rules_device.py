@@ -1,0 +1,211 @@
+"""Rules: device-sync discipline & buffer-donation aliasing.
+
+``device-sync`` — the ordering fast path (PR 7) and per-shard pipelined
+readbacks (PR 9) exist so the device→host round-trip overlaps a tick of
+host work. ONE stray synchronizing call — ``np.asarray`` on a device
+value, ``jax.device_get``, ``.block_until_ready()``, or an implicit
+``float()``/``int()`` coercion of a jnp value — re-serializes the
+pipeline and silently defeats the contract. Host↔device traffic is
+sanctioned only inside the readback modules (``tpu/vote_plane.py``,
+``tpu/quorum.py``); every other jax-importing module must either stay
+on-device or carry a pragma naming why its sync is deliberate (e.g. the
+auth batch must resolve before admission decides).
+
+``buffer-donation`` — PR 3's corruption gotcha: on jax 0.4.37's CPU
+backend ``jnp.asarray`` ZERO-COPIES suitably aligned host numpy buffers.
+A reusable staging buffer (an attribute that outlives the call) handed
+to the device through ``asarray`` aliases live in-flight dispatch
+memory — the next host write corrupts a vote word mid-flight. Reused
+buffers must cross with a forced copy (``jnp.array``); only FRESH
+per-call buffers may take the zero-copy path. Until this rule, that
+invariant lived in one comment in ``vote_plane.py``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    iter_scope,
+    resolve_call_name,
+)
+
+__all__ = ["DeviceSyncRule", "BufferDonationRule"]
+
+
+def _jax_tainted_names(fn, imports) -> Set[str]:
+    """Names assigned from expressions that touch jax/jnp — one-hop
+    intra-function taint, enough for the float()/int() coercion check."""
+    tainted: Set[str] = set()
+    for node in iter_scope(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        touches_jax = False
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Name):
+                canon = imports.get(sub.id, "")
+                if canon == "jax" or canon.startswith("jax."):
+                    touches_jax = True
+                    break
+        if touches_jax:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+    return tainted
+
+
+class DeviceSyncRule(Rule):
+    name = "device-sync"
+    summary = ("host<->device synchronization outside the sanctioned "
+               "readback modules (defeats pipelined readbacks)")
+
+    # the two modules whose JOB is the device->host boundary
+    ALLOWLIST = (
+        "indy_plenum_tpu/tpu/vote_plane.py",
+        "indy_plenum_tpu/tpu/quorum.py",
+    )
+
+    def check_module(self, module: ModuleInfo) -> List[Finding]:
+        if module.path in self.ALLOWLIST:
+            return []
+        if not self._in_scope(module):
+            return []
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted = _jax_tainted_names(fn, module.imports)
+            for node in iter_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._classify(node, module, tainted)
+                if msg is not None:
+                    findings.append(Finding(
+                        rule=self.name, path=module.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=msg + " — a sync outside vote_plane/"
+                                "quorum stalls the pipelined-readback "
+                                "contract; move it behind the compact "
+                                "readback or pragma why this boundary "
+                                "crossing is deliberate"))
+        # module-level code (import-time table building etc.) is checked
+        # too: walk calls not inside any function
+        fn_calls = {id(n) for f in ast.walk(module.tree)
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    for n in ast.walk(f) if isinstance(n, ast.Call)}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and id(node) not in fn_calls:
+                msg = self._classify(node, module, set())
+                if msg is not None:
+                    findings.append(Finding(
+                        rule=self.name, path=module.path,
+                        line=node.lineno, col=node.col_offset,
+                        message=msg + " at module scope — import-time "
+                                "host<->device traffic; pragma if this "
+                                "is deliberate table building"))
+        return findings
+
+    @staticmethod
+    def _in_scope(module: ModuleInfo) -> bool:
+        """Modules importing jax directly, any tpu kernel wrapper
+        (``from ..tpu import ed25519`` hands back device arrays too),
+        or living under tpu/ themselves (siblings get kernels via bare
+        ``from . import ...`` imports)."""
+        if module.path.startswith("indy_plenum_tpu/tpu/"):
+            return True
+        if module.imports_module("jax"):
+            return True
+        for canon in module.imports.values():
+            if canon.startswith("tpu.") or ".tpu." in canon \
+                    or canon.endswith(".tpu"):
+                return True
+        return False
+
+    @staticmethod
+    def _classify(node: ast.Call, module: ModuleInfo,
+                  tainted: Set[str]) -> Optional[str]:
+        canon = resolve_call_name(node.func, module.imports)
+        if canon == "numpy.asarray":
+            return "np.asarray() pulls its argument to host memory"
+        if canon in ("jax.device_get", "jax.block_until_ready"):
+            return f"{canon.split('.', 1)[1]}() synchronizes device state"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "block_until_ready":
+            return ".block_until_ready() blocks on the device stream"
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in tainted:
+                return (f"{node.func.id}('{arg.id}') implicitly syncs a "
+                        "jnp value to host")
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    canon_a = module.imports.get(sub.id, "")
+                    if canon_a == "jax" or canon_a.startswith("jax."):
+                        return (f"{node.func.id}(...) over a jnp "
+                                "expression implicitly syncs to host")
+        return None
+
+
+class BufferDonationRule(Rule):
+    name = "buffer-donation"
+    summary = ("jnp.asarray on a reusable staging buffer (jax 0.4.37 "
+               "zero-copy aliasing: reused buffers need the forced "
+               "jnp.array copy)")
+
+    def check_module(self, module: ModuleInfo) -> List[Finding]:
+        if not module.imports_module("jax"):
+            return []
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # names bound from self-attributes in this function: a
+            # local alias of a persistent buffer is still the buffer
+            attr_aliases: Set[str] = set()
+            for node in iter_scope(fn):
+                if isinstance(node, ast.Assign) \
+                        and self._is_self_attr_load(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            attr_aliases.add(tgt.id)
+            for node in iter_scope(fn):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                canon = resolve_call_name(node.func, module.imports)
+                if canon != "jax.numpy.asarray":
+                    continue
+                if self._is_reused_buffer(node.args[0], attr_aliases):
+                    findings.append(Finding(
+                        rule=self.name, path=module.path,
+                        line=node.lineno, col=node.col_offset,
+                        message="jnp.asarray(...) over a persistent "
+                                "buffer: jax 0.4.37's CPU backend "
+                                "zero-copies aligned numpy memory, so "
+                                "the reused buffer aliases in-flight "
+                                "dispatch data — use jnp.array(...) "
+                                "(forced copy) for buffers that "
+                                "outlive the call"))
+        return findings
+
+    @staticmethod
+    def _is_self_attr_load(node: ast.AST) -> bool:
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    @classmethod
+    def _is_reused_buffer(cls, arg: ast.AST,
+                          attr_aliases: Set[str]) -> bool:
+        node = arg
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if cls._is_self_attr_load(node):
+            return True
+        return isinstance(node, ast.Name) and node.id in attr_aliases

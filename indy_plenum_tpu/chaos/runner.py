@@ -53,7 +53,7 @@ def _catchup_block(pool, plan, scenario, leech_floor) -> dict:
     if not leechers:
         return {}
     per_node = {name: l.catchup_stats() for name, l in leechers.items()}
-    totals = {k: sum(stats[k] for stats in per_node.values())
+    totals = {k: sum(per_node[name][k] for name in sorted(per_node))
               for k in ("rounds_completed", "txns_leeched",
                         "proofs_verified", "reps_rejected", "retries")}
     block = {

@@ -36,6 +36,7 @@ class PoolCli:
         self._client = None
         self._trustee = None
         self._aliases = {}  # alias -> DidSigner (targets we created)
+        # da: allow[nondet-source] -- interactive CLI seeds req ids from the wall clock; seeded runs drive SimPool/NodePool, never the CLI
         self._req_id = int(time.time()) % 1_000_000
 
     def _print(self, text: str) -> None:

@@ -49,6 +49,7 @@ class Looper:
                  idle_sleep: float = 0.002):
         # epoch-aligned monotonic clock: protocol timestamps (ppTime) are
         # wall-clock epoch seconds, but scheduling must never jump backwards
+        # da: allow-file[nondet-source] -- the DEPLOYED event loop runs on real time; simulation pools inject MockTimer and never construct this clock
         epoch_offset = time.time() - time.monotonic()
         self.timer = timer or QueueTimer(
             lambda: epoch_offset + time.monotonic())

@@ -187,6 +187,7 @@ class MetricsCollector:
         raising bodies can never pollute the hot-path latency stats the
         dispatch plane is judged by (and the error count is itself an
         observable)."""
+        # da: allow-file[nondet-source] -- wall-duration METERS only: metric values never feed consensus state, message contents or any *_hash fingerprint
         t0 = time.perf_counter()
         try:
             yield

@@ -218,6 +218,7 @@ class PoolClientStack:
         self.name = name
         self.on_message = on_message  # (node_name, msg) -> None
         self._msg_len_limit = msg_len_limit
+        # da: allow[nondet-source] -- CurveZMQ session keypair generation: entropy by design (crypto keygen seam), never replayed
         public, secret = curve_keypair_from_seed(os.urandom(32))
         self._ctx = zmq.Context()
         self._ctx.set(zmq.BLOCKY, False)  # never hang shutdown on term()

@@ -16,6 +16,7 @@ service() flush (reference: plenum/common/batched.py), bounded by
 
 Wire format: msgpack of the registry dict form (``op`` field dispatch).
 """
+# da: allow-file[nondet-source] -- DEPLOYED transport: reconnect/monitor timers and the wire-trace clock read real time; the seeded transport is simulation/sim_network.py on the virtual clock
 from __future__ import annotations
 
 import logging
@@ -315,6 +316,7 @@ class ZStack:
                 # what gets delivered, so this copy ships untraced
                 self._outbox[peer].append(serialize_msg(obj))
                 continue
+            # da: allow[trace-guard] -- key is non-None ONLY when self.trace.enabled held at the top of send(); this loop is unreachable untraced
             self.trace.record("net.send", cat="net", node=self.name,
                               key=key,
                               args={"m": obj["op"], "to": peer,

@@ -16,6 +16,8 @@ back as a bool vector. This is BASELINE config 5's hot loop (audit-path
 batch verify at 1M txns). A scalar host fallback (MerkleVerifier) remains
 for tiny slices where the device round-trip outweighs the math.
 """
+# da: allow-file[nondet-source] -- _AdaptiveOffload's perf_counter probes STEER device-vs-host placement only: both paths verify identical proofs to identical verdicts, so ordering/ledger state and every fingerprint replay bit-identically under either choice
+# da: allow-file[device-sync] -- the chunked audit-proof offload deliberately syncs (block_until_ready warm-up, np.asarray verdict resolve): catchup runs OFF the ordering tick loop, and the resolved verdict vector IS the product — the pipelined-readback contract governs the vote plane, not this recovery path
 from __future__ import annotations
 
 import logging

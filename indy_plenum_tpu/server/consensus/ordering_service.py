@@ -371,6 +371,8 @@ class OrderingService:
         """Record ``3pc.commit_quorum`` ONCE per key, at the instant the
         service first sees the quorum (trace-gated: pure observability,
         the ordering path never depends on it)."""
+        if not self._trace.enabled:
+            return  # keeps the guard local: callers need not re-check
         if key in self._commit_quorum_marked:
             return
         pp = self.prePrepares.get(key)

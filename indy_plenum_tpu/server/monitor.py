@@ -338,6 +338,11 @@ class Monitor:
         if len(self._latencies) < 2:
             return False
         master = self._latencies[0].get_avg_latency()
+        if master is not None and master > self._config.LAMBDA:
+            # RBFT Λ (Aublin et al. §IV): the master's ABSOLUTE request
+            # latency bound — a master slow against the wall even when
+            # every backup is equally slow (Ω alone cannot see that)
+            return True
         backups = [l.get_avg_latency() for l in self._latencies[1:]]
         backups = [b for b in backups if b is not None]
         if master is None or not backups:

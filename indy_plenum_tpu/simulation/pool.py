@@ -594,11 +594,13 @@ class SimPool:
                 if inflight[0]:
                     return inner(*args, **kwargs)
                 inflight[0] = True
+                # da: allow[nondet-source] -- per-node host-CPU accounting for profile_rbft; protocol time rides MockTimer, acct never feeds consensus
                 t0 = _time.perf_counter()
                 try:
                     return inner(*args, **kwargs)
                 finally:
                     inflight[0] = False
+                    # da: allow[nondet-source] -- accounting close (see t0 above)
                     acct[name] += _time.perf_counter() - t0
             return wrapper
 

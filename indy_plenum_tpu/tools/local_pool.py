@@ -51,6 +51,7 @@ def generate_pool_config(directory: str, n_nodes: int = 4,
     keys_dir = os.path.join(directory, KEYS_DIR)
     os.makedirs(keys_dir, exist_ok=True)
     if master_seed is None:
+        # da: allow[nondet-source] -- master-key generation for a REAL local pool: entropy by design; reproducible fixtures pass master_seed explicitly
         master_seed = os.urandom(32)
 
     def derive(tag: str) -> bytes:

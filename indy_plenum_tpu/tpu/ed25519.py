@@ -340,4 +340,5 @@ def batch_verify(
 
     ok = verify_kernel_full(padded(pk_a), padded(r_a), padded(s_a),
                             padded(blocks), padded(counts))
+    # da: allow[device-sync] -- verify_batch is the kernel's OWN blocking entry point (callers wanting overlap use verify_kernel_full + deferred resolve)
     return np.asarray(ok)[:n] & pre

@@ -3,7 +3,8 @@
 PR 2 froze the dispatch plane's tick at a static ``QuorumTickInterval``.
 That interval is a throughput/latency dial with no single right setting:
 too wide and a 3PC wave waits most of a tick for its quorum verdicts
-(Max3PCBatchesInFlight stalls the pipeline); too narrow and an idle or
+(the bounded in-flight batch window stalls the pipeline); too narrow
+and an idle or
 trickling pool pays a near-empty padded scatter per tick. RBFT's
 throughput case (Aublin et al., ICDCS 2013) and the pipelined-BFT designs
 (HotStuff, PODC 2019) both point the same way: the win is keeping device

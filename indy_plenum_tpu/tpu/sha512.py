@@ -317,6 +317,7 @@ def pad_ed25519_messages(prefixes, msgs, max_blocks: int
     for i, m in enumerate(msgs):
         by_len[len(m)].append(i)
     for mlen, idx_list in by_len.items():
+        # da: allow[device-sync] -- host-side scatter-index packing (a python list); no device value involved
         idxs = np.asarray(idx_list)
         total = plen + mlen
         nb = (total + 17 + 127) // 128

@@ -84,8 +84,18 @@ and execution across the pool), if the traced ordered digests diverge
 from the untraced run, or if e2e p99 (client ingress -> executed,
 virtual protocol time) exceeds ``--e2e-budget``.
 
+Static gate (PR 13): unless ``--no-static-gate``, the pure-AST
+determinism & hot-path analyzer (``indy_plenum_tpu.analysis``) runs
+over the whole package TWICE and fails if any unsuppressed finding
+remains (the shipped baseline is empty — new wall-clock reads, unseeded
+RNGs, unordered fingerprint iterations, unguarded hot-path trace args,
+stray device syncs, aliasing ``jnp.asarray`` staging hand-offs or
+orphan/unknown config knobs fail closed) or if ``findings_hash`` drifts
+between the two runs (the analyzer obeys the same byte-identical replay
+contract it enforces).
+
 Running one gate: ``--only latency`` (or ``--only trace,latency``)
-replaces stacking eight ``--no-*-gate`` flags; ``--list-gates`` prints
+replaces stacking nine ``--no-*-gate`` flags; ``--list-gates`` prints
 the names.
 
 Usage:
@@ -872,10 +882,62 @@ def latency_gate(args, traced: "dict | None" = None,
     return record, failures
 
 
+def static_gate(args) -> "tuple[dict, list]":
+    """Determinism & hot-path hygiene gate (static analysis plane): the
+    pure-AST analyzer runs over ``indy_plenum_tpu/`` TWICE on the SAME
+    rule catalog and fails if
+
+    1. any UNSUPPRESSED finding remains — the shipped baseline is
+       empty, so a new nondeterminism source / fingerprint-ordering
+       hazard / unguarded trace arg / stray device sync / staging-
+       buffer alias / config-knob orphan fails closed the moment it is
+       committed, whether or not a dynamic gate's seeds exercise it;
+    2. any pragma suppressing a finding lacks a justification (the
+       ``pragma`` rule fires, which is itself unsuppressed);
+    3. ``findings_hash`` is not byte-identical across the two runs —
+       the analyzer obeys the replay contract it enforces.
+    """
+    from collections import Counter
+
+    from indy_plenum_tpu.analysis import analyze_paths
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "indy_plenum_tpu")
+    first = analyze_paths([pkg])
+    replay = analyze_paths([pkg])
+    failures = []
+    if first.unsuppressed:
+        head = "; ".join(f.render() for f in first.unsuppressed[:5])
+        failures.append(
+            f"{len(first.unsuppressed)} unsuppressed static finding(s) "
+            f"(run scripts/lint_determinism.py for the list): {head}")
+    if replay.findings_hash != first.findings_hash:
+        failures.append(
+            "static findings_hash drifts across identical runs "
+            f"({first.findings_hash[:12]} vs "
+            f"{replay.findings_hash[:12]}) — the analyzer itself is "
+            "nondeterministic")
+    by_rule = Counter(f.rule for f in first.findings)
+    record = {
+        "files_analyzed": first.files_analyzed,
+        "rules": first.rules,
+        "findings_total": len(first.findings),
+        "unsuppressed": len(first.unsuppressed),
+        "suppressed": len(first.suppressed),
+        "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+        "findings_hash": first.findings_hash,
+        "replay_identical": replay.findings_hash == first.findings_hash,
+    }
+    return record, failures
+
+
 # gate registry (--list-gates / --only): name -> (argparse dest of the
 # skip flag, one-line description). The core dispatch-budget measurement
 # always runs — it is the baseline every budget compares against.
 GATES = {
+    "static": ("no_static_gate",
+               "determinism & hot-path static analysis (zero "
+               "unsuppressed, 2-run findings_hash identity)"),
     "governor": ("no_governor_gates",
                  "bursty static-vs-adaptive tick comparison"),
     "sharded": ("no_sharded_gate", "1-device vs mesh-sharded identity"),
@@ -931,9 +993,13 @@ def main() -> int:
                          "(byte-identical journey tables, zero orphan "
                          "spans, traced-vs-untraced ordered_hash, e2e "
                          "p99 budget)")
+    ap.add_argument("--no-static-gate", action="store_true",
+                    help="skip the determinism & hot-path static-"
+                         "analysis gate (zero unsuppressed findings, "
+                         "byte-stable findings_hash across two runs)")
     ap.add_argument("--only", default=None, metavar="GATE[,GATE]",
                     help="run ONLY the named gate(s) — e.g. '--only "
-                         "latency' instead of stacking eight --no-*-gate "
+                         "latency' instead of stacking nine --no-*-gate "
                          "flags; see --list-gates for names. The core "
                          "dispatch-budget measurement always runs")
     ap.add_argument("--list-gates", action="store_true",
@@ -1020,6 +1086,10 @@ def main() -> int:
     if per_msg > args.budget_per_message:
         over.append(f"dispatches/message {per_msg} "
                     f"> {args.budget_per_message}")
+    if not args.no_static_gate:
+        record, failures = static_gate(args)
+        result["static_gate"] = record
+        over.extend(failures)
     if not args.no_governor_gates:
         record, failures = governor_gates(args)
         result["governor_gate"] = record

@@ -1,0 +1,677 @@
+"""Determinism & hot-path hygiene analyzer (indy_plenum_tpu.analysis).
+
+Per-rule fixture snippets (positive + suppressed + allowlisted), the
+pragma grammar self-lint, findings_hash byte-determinism, CLI
+subprocess smoke, and the tier-1 whole-repo clean run that fails this
+suite the moment a new unsuppressed finding lands anywhere in the
+package.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from indy_plenum_tpu.analysis import (
+    Analyzer,
+    ModuleInfo,
+    analyze_paths,
+    analyze_source,
+    make_rules,
+)
+from indy_plenum_tpu.analysis.rules_config import ConfigKnobRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "indy_plenum_tpu")
+LINT = os.path.join(REPO, "scripts", "lint_determinism.py")
+
+
+def rules_of(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+def unsuppressed_of(report, rule):
+    return [f for f in report.unsuppressed if f.rule == rule]
+
+
+def src(text):
+    return textwrap.dedent(text)
+
+
+# --- nondet-source ------------------------------------------------------
+
+class TestNondetSource:
+    def test_wall_clock_flagged_through_alias(self):
+        rep = analyze_source(src("""
+            import time as _t
+
+            def f():
+                return _t.perf_counter()
+        """))
+        hits = unsuppressed_of(rep, "nondet-source")
+        assert len(hits) == 1 and "time.perf_counter" in hits[0].message
+
+    def test_from_import_and_datetime(self):
+        rep = analyze_source(src("""
+            from time import monotonic
+            from datetime import datetime
+
+            def f():
+                return monotonic(), datetime.now()
+        """))
+        assert len(unsuppressed_of(rep, "nondet-source")) == 2
+
+    def test_unseeded_rng_flagged_seeded_ok(self):
+        rep = analyze_source(src("""
+            import random
+            import numpy as np
+
+            def bad():
+                return random.Random(), np.random.RandomState(), \\
+                    random.randint(0, 4), np.random.rand(3)
+
+            def good(seed):
+                return random.Random(seed), np.random.RandomState(seed)
+        """))
+        assert len(unsuppressed_of(rep, "nondet-source")) == 4
+
+    def test_pragma_suppresses_with_reason(self):
+        rep = analyze_source(src("""
+            import time
+
+            def f():
+                t0 = time.perf_counter()  # da: allow[nondet-source] -- wall meter
+                return t0
+        """))
+        assert not unsuppressed_of(rep, "nondet-source")
+        assert rules_of(rep, "nondet-source")[0].suppressed == "pragma"
+        assert rules_of(rep, "nondet-source")[0].reason == "wall meter"
+
+    def test_standalone_pragma_covers_next_line(self):
+        rep = analyze_source(src("""
+            import time
+
+            def f():
+                # da: allow[nondet-source] -- wall meter spanning a long call
+                t0 = time.perf_counter()
+                return t0
+        """))
+        assert not unsuppressed_of(rep, "nondet-source")
+
+    def test_file_level_pragma(self):
+        rep = analyze_source(src("""
+            # da: allow-file[nondet-source] -- deployed-clock module
+            import time
+
+            def f():
+                return time.time()
+
+            def g():
+                return time.monotonic()
+        """))
+        assert not unsuppressed_of(rep, "nondet-source")
+        assert len(rules_of(rep, "nondet-source")) == 2
+
+    def test_crypto_allowlist(self):
+        rep = analyze_source(src("""
+            import os
+
+            def keygen():
+                return os.urandom(32)
+        """), path="indy_plenum_tpu/crypto/newkeys.py")
+        assert not rules_of(rep, "nondet-source")
+
+    def test_docstring_grammar_is_not_a_pragma(self):
+        rep = analyze_source(src('''
+            import time
+
+            def f():
+                """Examples: # da: allow[nondet-source] -- quoted"""
+                return time.time()
+        '''))
+        assert len(unsuppressed_of(rep, "nondet-source")) == 1
+
+
+# --- pragma self-lint ---------------------------------------------------
+
+class TestPragmaRule:
+    def test_missing_reason_is_a_finding(self):
+        rep = analyze_source(src("""
+            import time
+
+            def f():
+                return time.time()  # da: allow[nondet-source]
+        """))
+        msgs = [f.message for f in unsuppressed_of(rep, "pragma")]
+        assert any("missing justification" in m for m in msgs)
+
+    def test_unknown_rule_is_a_finding(self):
+        rep = analyze_source(src("""
+            x = 1  # da: allow[no-such-rule] -- because
+        """))
+        msgs = [f.message for f in unsuppressed_of(rep, "pragma")]
+        assert any("unknown rule 'no-such-rule'" in m for m in msgs)
+
+
+# --- hash-id-flow -------------------------------------------------------
+
+class TestHashIdFlow:
+    def test_hash_into_sink(self):
+        rep = analyze_source(src("""
+            import hashlib
+
+            def fingerprint(items):
+                h = hash(tuple(items))
+                return hashlib.sha256(str(h).encode()).hexdigest()
+        """))
+        assert len(unsuppressed_of(rep, "hash-id-flow")) == 1
+
+    def test_dunder_hash_exempt(self):
+        rep = analyze_source(src("""
+            class K:
+                def __hash__(self):
+                    return hash((self.a, self.b))
+        """))
+        assert not rules_of(rep, "hash-id-flow")
+
+    def test_plain_hash_without_sink_ok(self):
+        rep = analyze_source(src("""
+            def bucket(key, n):
+                return hash(key) % n
+        """))
+        assert not rules_of(rep, "hash-id-flow")
+
+
+# --- unordered-fingerprint ----------------------------------------------
+
+class TestUnorderedFingerprint:
+    def test_set_iteration_in_hash_fn(self):
+        rep = analyze_source(src("""
+            import hashlib
+
+            def ordered_hash(digests):
+                acc = hashlib.sha256()
+                for d in set(digests):
+                    acc.update(d)
+                return acc.hexdigest()
+        """))
+        assert len(unsuppressed_of(rep, "unordered-fingerprint")) == 1
+
+    def test_sorted_wrapper_ok(self):
+        rep = analyze_source(src("""
+            import hashlib
+
+            def ordered_hash(digests):
+                acc = hashlib.sha256()
+                for d in sorted(set(digests)):
+                    acc.update(d)
+                return acc.hexdigest()
+        """))
+        assert not rules_of(rep, "unordered-fingerprint")
+
+    def test_dict_values_and_named_set(self):
+        rep = analyze_source(src("""
+            def trace_hash(by_node):
+                seen = set()
+                rows = [v for v in by_node.values()]
+                rows += [s for s in seen]
+                return my_hash(rows)
+        """))
+        assert len(unsuppressed_of(rep, "unordered-fingerprint")) == 2
+
+    def test_non_fingerprint_function_exempt(self):
+        rep = analyze_source(src("""
+            def drain(pending):
+                for p in set(pending):
+                    p.fire()
+        """))
+        assert not rules_of(rep, "unordered-fingerprint")
+
+
+# --- trace-guard --------------------------------------------------------
+
+_HOT = "indy_plenum_tpu/tpu/fake_plane.py"
+
+
+class TestTraceGuard:
+    def test_unguarded_allocating_args_flagged(self):
+        rep = analyze_source(src("""
+            def flush(self):
+                self.trace.record("flush.dispatch", cat="dispatch",
+                                  args={"votes": self.votes})
+        """), path=_HOT)
+        assert len(unsuppressed_of(rep, "trace-guard")) == 1
+
+    def test_guarded_if_and_guard_name(self):
+        rep = analyze_source(src("""
+            def flush(self):
+                if self.trace.enabled:
+                    self.trace.record("a", args={"v": 1 + 1})
+                trace_on = self.trace.enabled
+                if trace_on:
+                    self.trace.record("b", args={"v": self.x * 2})
+        """), path=_HOT)
+        assert not rules_of(rep, "trace-guard")
+
+    def test_ifexp_span_guard(self):
+        rep = analyze_source(src("""
+            def tick(self, _NO_SPAN):
+                with self.trace.span("tick.eval",
+                                     args={"n": len(self.nodes)}) \\
+                        if self.trace.enabled else _NO_SPAN:
+                    pass
+        """), path=_HOT)
+        assert not rules_of(rep, "trace-guard")
+
+    def test_early_exit_guard(self):
+        rep = analyze_source(src("""
+            def mark(self, key):
+                if not self.trace.enabled:
+                    return
+                self.trace.record("m", key=(key, self.view_no))
+        """), path=_HOT)
+        assert not rules_of(rep, "trace-guard")
+
+    def test_constant_args_exempt(self):
+        rep = analyze_source(src("""
+            def tick(self):
+                self.trace.record("tick.drain", cat="dispatch")
+        """), path=_HOT)
+        assert not rules_of(rep, "trace-guard")
+
+    def test_out_of_scope_package_exempt(self):
+        rep = analyze_source(src("""
+            def report(self):
+                self.trace.record("chaos.fault", args={"k": [1, 2]})
+        """), path="indy_plenum_tpu/chaos/fake.py")
+        assert not rules_of(rep, "trace-guard")
+
+
+# --- device-sync --------------------------------------------------------
+
+class TestDeviceSync:
+    def test_sync_calls_flagged(self):
+        rep = analyze_source(src("""
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def readback(dev):
+                host = np.asarray(dev)
+                full = jax.device_get(dev)
+                dev.block_until_ready()
+                return host, full
+        """), path="indy_plenum_tpu/server/fake.py")
+        assert len(unsuppressed_of(rep, "device-sync")) == 3
+
+    def test_float_coercion_on_jnp_value(self):
+        rep = analyze_source(src("""
+            import jax.numpy as jnp
+
+            def occupancy(votes, cap):
+                frac = jnp.sum(votes) / cap
+                return float(frac)
+        """), path="indy_plenum_tpu/server/fake.py")
+        assert len(unsuppressed_of(rep, "device-sync")) == 1
+
+    def test_sanctioned_modules_exempt(self):
+        code = src("""
+            import jax
+            import numpy as np
+
+            def absorb(dev):
+                return np.asarray(jax.device_get(dev))
+        """)
+        for path in ("indy_plenum_tpu/tpu/vote_plane.py",
+                     "indy_plenum_tpu/tpu/quorum.py"):
+            assert not rules_of(analyze_source(code, path=path),
+                                "device-sync")
+
+    def test_non_jax_module_exempt(self):
+        rep = analyze_source(src("""
+            import numpy as np
+
+            def pack(rows):
+                return np.asarray(rows)
+        """), path="indy_plenum_tpu/ledger/fake.py")
+        assert not rules_of(rep, "device-sync")
+
+
+# --- buffer-donation ----------------------------------------------------
+
+class TestBufferDonation:
+    def test_persistent_buffer_flagged(self):
+        rep = analyze_source(src("""
+            import jax.numpy as jnp
+
+            def stage(self):
+                return jnp.asarray(self._scatter_buf)
+        """), path="indy_plenum_tpu/tpu/fake_plane.py")
+        assert len(unsuppressed_of(rep, "buffer-donation")) == 1
+
+    def test_local_alias_of_buffer_flagged(self):
+        rep = analyze_source(src("""
+            import jax.numpy as jnp
+
+            def stage(self):
+                buf = self._bufs[64]
+                buf[:] = 0
+                return jnp.asarray(buf)
+        """), path="indy_plenum_tpu/tpu/fake_plane.py")
+        assert len(unsuppressed_of(rep, "buffer-donation")) == 1
+
+    def test_fresh_value_and_forced_copy_ok(self):
+        rep = analyze_source(src("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def stage(self, words):
+                fresh = np.zeros((4, 64), np.uint32)
+                return jnp.asarray(fresh), jnp.array(self._buf), \\
+                    jnp.asarray(words_row(words))
+        """), path="indy_plenum_tpu/tpu/fake_plane.py")
+        assert not rules_of(rep, "buffer-donation")
+
+
+# --- config-knob --------------------------------------------------------
+
+_CONFIG_FIXTURE = src("""
+    from dataclasses import dataclass
+
+    @dataclass
+    class Config:
+        KnobUsed: int = 1
+        KnobOrphan: int = 2
+        KnobPragmad: int = 3  # da: allow[config-knob] -- read by external scripts
+""")
+
+
+def _knob_report(consumer_src):
+    analyzer = Analyzer(make_rules())
+    mods = [
+        ModuleInfo.from_source(_CONFIG_FIXTURE,
+                               path="fakepkg/config.py"),
+        ModuleInfo.from_source(consumer_src, path="fakepkg/user.py"),
+    ]
+    return analyzer.analyze_modules(mods)
+
+
+class TestConfigKnob:
+    def test_unknown_read_and_orphan_flagged(self):
+        rep = _knob_report(src("""
+            def f(config):
+                return config.KnobUsed + config.KnobTypo
+        """))
+        msgs = [f.message for f in unsuppressed_of(rep, "config-knob")]
+        assert any("'KnobTypo' has no default" in m for m in msgs)
+        assert any("'KnobOrphan' is defined but never read" in m
+                   for m in msgs)
+        assert not any("KnobUsed" in m or "KnobPragmad" in m
+                       for m in msgs)
+
+    def test_getattr_read_counts(self):
+        rep = _knob_report(src("""
+            def f(config):
+                return getattr(config, "KnobOrphan", None)
+        """))
+        msgs = [f.message for f in unsuppressed_of(rep, "config-knob")]
+        assert not any("KnobOrphan" in m for m in msgs)
+
+    def test_registry_renders_markdown(self):
+        rule = ConfigKnobRule()
+        analyzer = Analyzer([rule])
+        analyzer.analyze_modules([
+            ModuleInfo.from_source(_CONFIG_FIXTURE,
+                                   path="fakepkg/config.py"),
+            ModuleInfo.from_source(
+                "def f(config):\n    return config.KnobUsed\n",
+                path="fakepkg/user.py"),
+        ])
+        table = rule.render_registry()
+        assert "| Knob | Default | Read by |" in table
+        assert "| `KnobUsed` | `1` |" in table
+
+
+# --- whole-repo gate + determinism --------------------------------------
+
+class TestWholeRepo:
+    def test_package_is_clean(self):
+        """THE tier-1 backstop: any new unsuppressed finding anywhere in
+        indy_plenum_tpu/ fails this test — fix it or pragma it with a
+        justification."""
+        report = analyze_paths([PKG])
+        pretty = "\n".join(f.render() for f in report.unsuppressed)
+        assert not report.unsuppressed, f"new static findings:\n{pretty}"
+
+    def test_findings_hash_byte_identical_across_runs(self):
+        r1 = analyze_paths([PKG])
+        r2 = analyze_paths([PKG])
+        assert r1.findings_hash == r2.findings_hash
+        assert [f.to_dict() for f in r1.findings] \
+            == [f.to_dict() for f in r2.findings]
+
+    def test_every_pragma_has_a_reason(self):
+        report = analyze_paths([PKG])
+        for f in report.findings:
+            if f.suppressed == "pragma":
+                assert f.reason, f"reasonless pragma suppressing {f}"
+
+    def test_shipped_baseline_is_empty(self):
+        from indy_plenum_tpu.analysis import DEFAULT_BASELINE, \
+            load_baseline
+        assert load_baseline(DEFAULT_BASELINE) == set(), \
+            "the shipped baseline must stay empty — fix or pragma " \
+            "findings instead of baselining them"
+
+
+class TestReviewRegressions:
+    def test_rule_filter_keeps_full_catalog_for_pragma_lint(self):
+        """--rule nondet-source must not flag pragmas naming OTHER
+        shipped rules as unknown (the self-lint sees the catalog)."""
+        proc = _run_cli("indy_plenum_tpu", "--rule", "nondet-source",
+                        "--json")
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr
+        data = json.loads(proc.stdout)
+        assert data["unsuppressed"] == 0
+
+    def test_single_file_run_anchors_at_package_root(self):
+        """Per-file lint must name modules like a package walk would,
+        so path-prefix allowlists (crypto/ keygen) still apply."""
+        proc = _run_cli(os.path.join(PKG, "crypto", "signers.py"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_baseline_cannot_suppress_pragma_findings(self, tmp_path):
+        from indy_plenum_tpu.analysis import write_baseline
+
+        mod = tmp_path / "mod.py"
+        mod.write_text("import time\n\n"
+                       "def f():\n"
+                       "    return time.time()  # da: allow[nondet-source]\n")
+        first = analyze_paths([str(mod)])
+        write_baseline(str(tmp_path / "bl.json"),
+                       [f.baseline_key() for f in first.unsuppressed])
+        again = analyze_paths([str(mod)],
+                              baseline_path=str(tmp_path / "bl.json"))
+        assert any(f.rule == "pragma" for f in again.unsuppressed), \
+            "reasonless-pragma findings must never be baselined away"
+
+    def test_subdirectory_run_anchors_at_package_root(self):
+        """`lint indy_plenum_tpu/tpu` must apply the same allowlists as
+        the whole-package walk (vote_plane is sanctioned by PATH)."""
+        proc = _run_cli(os.path.join(PKG, "tpu"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_missing_path_fails_closed(self):
+        proc = _run_cli("no/such/package")
+        assert proc.returncode != 0
+        assert "does not exist" in proc.stderr + proc.stdout
+
+    def test_unrelated_enabled_flag_is_not_a_trace_guard(self):
+        rep = analyze_source(src("""
+            def flush(self):
+                if self.metrics.enabled:
+                    self.trace.record("a", args={"v": self.x + 1})
+        """), path=_HOT)
+        assert len(unsuppressed_of(rep, "trace-guard")) == 1
+
+    def test_baseline_ordinals_distinguish_identical_findings(
+            self, tmp_path):
+        from indy_plenum_tpu.analysis import write_baseline
+
+        mod = tmp_path / "mod.py"
+        mod.write_text("import time\n\n"
+                       "def f():\n"
+                       "    a = time.time()\n"
+                       "    b = time.time()\n"
+                       "    return a, b\n")
+        first = analyze_paths([str(mod)])
+        keys = [f.baseline_key() for f in first.unsuppressed]
+        assert len(keys) == 2 and len(set(keys)) == 2
+        # baselining only the FIRST occurrence must leave the second
+        # (and any future identical finding) unsuppressed
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), keys[:1])
+        again = analyze_paths([str(mod)], baseline_path=str(bl))
+        assert len(again.unsuppressed) == 1
+
+    def test_inverted_guard_is_not_a_guard(self):
+        """`off = not trace.enabled; if off:` runs when tracing is
+        DISABLED — the allocating record inside must be flagged."""
+        rep = analyze_source(src("""
+            def flush(self):
+                off = not self.trace.enabled
+                if off:
+                    self.trace.record("a", args={"v": self.x + 1})
+        """), path=_HOT)
+        assert len(unsuppressed_of(rep, "trace-guard")) == 1
+
+    def test_negated_if_guards_the_else_branch(self):
+        rep = analyze_source(src("""
+            def flush(self):
+                if not self.trace.enabled:
+                    pass
+                else:
+                    self.trace.record("a", args={"v": self.x + 1})
+        """), path=_HOT)
+        assert not rules_of(rep, "trace-guard")
+
+    def test_bare_relative_tpu_import_in_scope(self):
+        """A tpu/ sibling getting kernels via `from . import ...` is
+        still device-sync scoped (the reviewer's staging.py case)."""
+        rep = analyze_source(src("""
+            import numpy as np
+            from . import ed25519 as ted
+
+            def readback(batch):
+                return np.asarray(ted.verify_kernel_full(batch))
+        """), path="indy_plenum_tpu/tpu/staging.py")
+        assert len(unsuppressed_of(rep, "device-sync")) == 1
+
+    def test_streaming_hashlib_update_is_a_sink(self):
+        rep = analyze_source(src("""
+            import hashlib
+
+            def ordered_hash(items):
+                h = hash(tuple(items))
+                acc = hashlib.sha256()
+                acc.update(str(h).encode())
+                return acc.hexdigest()
+        """))
+        assert len(unsuppressed_of(rep, "hash-id-flow")) == 1
+
+    def test_trailing_knob_pragma_does_not_leak_to_next_knob(self):
+        rule = ConfigKnobRule()
+        Analyzer([rule]).analyze_modules([ModuleInfo.from_source(src("""
+            from dataclasses import dataclass
+
+            @dataclass
+            class Config:
+                KnobA: int = 1  # da: allow[config-knob] -- read by scripts
+                KnobB: int = 2
+        """), path="fakepkg/config.py")])
+        assert rule.knob_defs["KnobA"].pragma_reason == "read by scripts"
+        assert rule.knob_defs["KnobB"].pragma_reason == ""
+
+    def test_nested_functions_are_separate_scopes(self):
+        rep = analyze_source(src("""
+            import hashlib
+
+            def outer(items):
+                h = hash(items[0])
+
+                def inner(xs):
+                    g = hash(xs)
+                    return hashlib.sha256(str(g).encode())
+                return inner, h
+        """))
+        hits = unsuppressed_of(rep, "hash-id-flow")
+        # exactly ONE finding, attributed to inner(); outer's unrelated
+        # taint must not bleed in and the site must not double-report
+        assert len(hits) == 1 and "inner()" in hits[0].message
+
+
+class TestBaseline:
+    def test_write_then_suppress_round_trip(self, tmp_path):
+        from indy_plenum_tpu.analysis import write_baseline
+
+        mod = tmp_path / "pkg" / "mod.py"
+        mod.parent.mkdir()
+        mod.write_text("import time\n\n"
+                       "def f():\n    return time.time()\n")
+        first = analyze_paths([str(mod.parent)])
+        assert len(first.unsuppressed) == 1
+        bl = tmp_path / "baseline.json"
+        write_baseline(str(bl),
+                       [f.baseline_key() for f in first.unsuppressed])
+        second = analyze_paths([str(mod.parent)],
+                               baseline_path=str(bl))
+        assert not second.unsuppressed
+        assert second.findings[0].suppressed == "baseline"
+
+
+# --- CLI smoke ----------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, LINT, *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+class TestCli:
+    def test_exit_1_on_finding_and_0_when_pragmad(self, tmp_path):
+        bad = tmp_path / "mod.py"
+        bad.write_text("import time\n\n"
+                       "def f():\n    return time.time()\n")
+        proc = _run_cli(str(bad))
+        assert proc.returncode == 1
+        assert "nondet-source" in proc.stdout
+        bad.write_text(
+            "import time\n\n"
+            "def f():\n"
+            "    # da: allow[nondet-source] -- fixture seam\n"
+            "    return time.time()\n")
+        proc = _run_cli(str(bad), "--json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        data = json.loads(proc.stdout)
+        assert data["unsuppressed"] == 0 and data["total"] == 1
+
+    def test_list_rules(self):
+        proc = _run_cli("--list-rules")
+        assert proc.returncode == 0
+        for name in ("nondet-source", "trace-guard", "device-sync",
+                     "buffer-donation", "config-knob",
+                     "unordered-fingerprint", "hash-id-flow", "pragma"):
+            assert name in proc.stdout
+
+    @pytest.mark.slow
+    def test_whole_package_cli_and_knob_registry(self):
+        proc = _run_cli("indy_plenum_tpu", "--json")
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr
+        data = json.loads(proc.stdout)
+        assert data["unsuppressed"] == 0
+        knobs = _run_cli("indy_plenum_tpu", "--emit-knobs")
+        assert knobs.returncode == 0
+        assert "| Knob | Default | Read by |" in knobs.stdout
+        assert "`QuorumTickInterval`" in knobs.stdout

@@ -251,8 +251,7 @@ def test_primary_crash_detected_and_view_changed_over_sockets():
     names = [f"node{i}" for i in range(4)]
     config = getConfig({"Max3PCBatchWait": 0.05, "Max3PCBatchSize": 10,
                         "PropagateBatchWait": 0.02,
-                        "ToleratePrimaryDisconnection": 1.0,
-                        "ViewChangeResendInterval": 1.0})
+                        "ToleratePrimaryDisconnection": 1.0})
     trustee = DidSigner(b"\x09" * 32)
     genesis = [genesis_nym_txn(trustee.identifier, trustee.verkey,
                                role=TRUSTEE)]

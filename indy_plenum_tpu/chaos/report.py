@@ -68,6 +68,11 @@ class ChaosReport:
     # requests whose journey crossed a fault window vs the ones that
     # ran clear
     journeys: Dict[str, Any] = field(default_factory=dict)
+    # ordering lanes (laned scenarios): router distribution, barrier
+    # counters (sealed window / seals / fingerprint chain tip), per-lane
+    # ordered hashes — the cross-lane ordering record the cross_lane
+    # invariant verified during the run
+    lanes: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> List[str]:
@@ -95,6 +100,8 @@ class ChaosReport:
             cmd += f" --mesh {mode['mesh']}"
         if mode.get("trace"):
             cmd += " --trace"
+        if mode.get("lanes"):
+            cmd += f" --lanes {mode['lanes']}"
         return cmd
 
     def as_dict(self) -> Dict[str, Any]:
@@ -124,6 +131,7 @@ class ChaosReport:
             "trace_file": self.trace_file,
             "flight_recorder": self.flight_recorder,
             "journeys": self.journeys,
+            "lanes": self.lanes,
         }
 
     def to_json(self, indent: int = 2) -> str:
@@ -179,6 +187,14 @@ class ChaosReport:
                     f"(p50 {fw['through_fault']['p50']} vs "
                     f"{fw['clear']['p50']} clear; "
                     f"p50_cost={fw['p50_cost']})")
+        if self.lanes:
+            ln = self.lanes
+            barrier = ln.get("barrier") or {}
+            lines.append(
+                f"  lanes: {ln.get('count')} "
+                f"router={ln.get('router', {}).get('distribution')} "
+                f"sealed_window={barrier.get('sealed_window')} "
+                f"seal_fp={str(barrier.get('seal_fingerprint'))[:16]}…")
         if self.trace_hash is not None:
             dumped = ", ".join(sorted({d.get("reason", "?")
                                        for d in self.flight_recorder})) \

@@ -158,6 +158,170 @@ def _catchup_verdicts(pool, plan, scenario, block) -> list:
     return out
 
 
+class _LaneZeroFacade:
+    """The fault plan's view of a :class:`~indy_plenum_tpu.lanes.pool
+    .LanedPool`: faults target lane 0 (the scenario's fault lane — its
+    network, its nodes), while the timer / trace / metrics are the
+    laned pool's shared ones. The healthy lanes feel the fault only
+    through the cross-lane barrier, which is exactly the coupling the
+    ``cross_lane`` invariant probes."""
+
+    def __init__(self, laned_pool):
+        self._lane = laned_pool.lane_pools[0]
+        self.network = self._lane.network
+        self.timer = laned_pool.timer
+        self.trace = laned_pool.trace
+        self.metrics = laned_pool.metrics
+        self.validators = self._lane.validators
+        self.nodes = self._lane.nodes
+
+    def node(self, name: str):
+        return self._lane.node(name)
+
+
+def _run_laned_scenario(scenario: Scenario, seed: int, n: int,
+                        out_path: Optional[str],
+                        probe_interval: float,
+                        device_quorum: bool,
+                        quorum_tick_interval: float,
+                        quorum_tick_adaptive: bool,
+                        trace: bool,
+                        trace_out: Optional[str]) -> ChaosReport:
+    """Laned scenarios (``scenario.lanes > 1``): the fault plan applies
+    inside lane 0 of a LanedPool, safety aggregates per lane + the
+    cross-lane barrier invariant, and liveness probes EVERY lane."""
+    from ..lanes import LanedPool
+    from .invariants import check_laned_liveness, check_laned_safety
+
+    plan = scenario.plan(seed, n)
+    overrides = {**BASE_CONFIG, **scenario.config_overrides}
+    if quorum_tick_interval > 0:
+        overrides["QuorumTickInterval"] = quorum_tick_interval
+        overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
+    config = getConfig(overrides)
+    pool = LanedPool(lanes=scenario.lanes, n_nodes=n, seed=seed,
+                     config=config, device_quorum=device_quorum,
+                     real_execution=scenario.real_execution,
+                     bls=scenario.bls,
+                     num_instances=scenario.num_instances,
+                     trace=trace)
+    facade = _LaneZeroFacade(pool)
+    scheduler = FaultScheduler(
+        facade, plan,
+        safety_probe=lambda: check_laned_safety(pool),
+        probe_interval=probe_interval).install()
+
+    for i in range(scenario.initial_requests):
+        pool.submit_request(i)
+    for i in range(scenario.trickle_requests):
+        pool.timer.schedule(
+            (i + 1) * scenario.trickle_interval,
+            lambda seq=scenario.initial_requests + i:
+            pool.submit_request(seq))
+
+    # faults land in lane 0: snapshot its restarted victims' committed
+    # ledger sizes at their restart instants (the leeched range starts
+    # there), exactly like the unlaned path
+    fault_lane = pool.lane_pools[0]
+    leech_floor: Dict[str, int] = {}
+    if scenario.real_execution:
+        from ..common.constants import DOMAIN_LEDGER_ID
+
+        def _snap_floor(victim: str) -> None:
+            node = fault_lane.node(victim)
+            if node.boot is not None:
+                leech_floor[victim] = node.boot.db.get_ledger(
+                    DOMAIN_LEDGER_ID).size
+
+        for fault in plan.faults:
+            if isinstance(fault, CrashFault) and fault.duration is not None:
+                pool.timer.schedule(fault.at + fault.duration,
+                                    lambda v=fault.node: _snap_floor(v))
+
+    horizon = max(scenario.run_seconds, plan.end_time + 5.0)
+    pool.run_for(horizon)
+    scheduler.stop_probe()
+
+    results = check_laned_safety(pool)
+    results.append(check_laned_liveness(
+        pool, probes=3, timeout=scenario.liveness_timeout))
+    # liveness mutated pool history (per-lane probes): re-verify the
+    # safety + cross-lane set over the post-probe state
+    results[:4] = check_laned_safety(pool)
+    metrics_summary = pool.metrics.summary()
+    # catchup requirements assert against the FAULT lane (recovery
+    # happened inside lane 0) — a laned scenario can no more 'pass' by
+    # silently skipping recovery than an unlaned one
+    catchup_block = _catchup_block(fault_lane, plan, scenario,
+                                   leech_floor)
+    results.extend(_catchup_verdicts(fault_lane, plan, scenario,
+                                     catchup_block))
+
+    network_totals = {"per_lane": {
+        f"lane{lane}": lp.network.counters()
+        for lane, lp in enumerate(pool.lane_pools)}}
+    for key in ("sent", "dropped", "duplicated"):
+        network_totals[key] = sum(
+            c[key] for c in (lp.network.counters()
+                             for lp in pool.lane_pools))
+    report = ChaosReport(
+        scenario=scenario.name,
+        seed=seed,
+        n_nodes=n,
+        dispatch_mode={
+            "device_quorum": device_quorum,
+            "tick": quorum_tick_interval,
+            "adaptive": quorum_tick_adaptive,
+            "mesh": 0,
+            "host_eval": False,
+            "trace": trace,
+            "lanes": scenario.lanes,
+        },
+        plan=plan.as_dicts(),
+        trace=list(scheduler.trace),
+        invariants=[r.as_dict() for r in results],
+        expected_failures=list(scenario.expect_fail),
+        network=network_totals,
+        metrics=metrics_summary,
+        ordered_per_node={
+            f"lane{lane}/{nd.name}": len(nd.ordered_digests)
+            for lane, lp in enumerate(pool.lane_pools)
+            for nd in lp.nodes},
+        ordered_hash_per_node={
+            f"lane{lane}/{nd.name}": hashlib.sha256(
+                "|".join(nd.ordered_digests).encode()).hexdigest()
+            for lane, lp in enumerate(pool.lane_pools)
+            for nd in lp.nodes},
+        lanes={
+            "count": pool.n_lanes,
+            "router": pool.router.counters(),
+            "barrier": pool.barrier.counters(),
+            "ordered_hash_per_lane": pool.ordered_hashes(),
+            "ordered_per_lane": pool.ordered_per_lane(),
+        },
+        catchup=catchup_block,
+        byzantine_nodes=sorted(plan.byzantine_nodes),
+        periodic_checks=len(scheduler.probe_results),
+        first_violation=scheduler.first_violation,
+        virtual_seconds=pool.timer.get_current_time()
+        - 1_700_000_000.0,
+    )
+    if trace:
+        jsonl = pool.trace.to_jsonl()
+        report.trace_hash = hashlib.sha256(jsonl.encode()).hexdigest()
+        report.flight_recorder = [dict(d) for d in pool.trace.dumps]
+        from ..observability.causal import journey_summary
+
+        report.journeys = journey_summary(pool.trace.events())
+        if trace_out is not None:
+            with open(trace_out, "w") as fh:
+                fh.write(jsonl)
+            report.trace_file = trace_out
+    if out_path is not None:
+        report.save(out_path)
+    return report
+
+
 def run_scenario(scenario: "str | Scenario", seed: int,
                  n_nodes: int = 0,
                  out_path: Optional[str] = None,
@@ -202,6 +366,14 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     if isinstance(scenario, str):
         scenario = get_scenario(scenario)
     n = n_nodes or scenario.n_nodes
+    if scenario.lanes > 1:
+        if mesh is not None or host_eval:
+            raise ValueError(
+                "laned scenarios run per-lane vote planes; mesh/host_eval"
+                " overrides are not supported on the laned path")
+        return _run_laned_scenario(
+            scenario, seed, n, out_path, probe_interval, device_quorum,
+            quorum_tick_interval, quorum_tick_adaptive, trace, trace_out)
     plan = scenario.plan(seed, n)
 
     overrides = {**BASE_CONFIG, **scenario.config_overrides}

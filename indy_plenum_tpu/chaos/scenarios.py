@@ -73,6 +73,12 @@ class Scenario:
     require_rejection: bool = False
     require_retries: bool = False
     proof_read: bool = False
+    # ordering lanes: > 1 routes the scenario through a LanedPool of
+    # this many lanes — faults apply INSIDE lane 0 (the runner's fault
+    # facade), per-lane safety aggregates, the cross_lane invariant
+    # (barrier seal/skew/fingerprint) probes continuously, and liveness
+    # probes every lane
+    lanes: int = 0
 
     def plan(self, seed: int, n_nodes: int = 0) -> FaultPlan:
         n = n_nodes or self.n_nodes
@@ -406,6 +412,53 @@ register(Scenario(
     num_instances=0,  # auto f+1: real RBFT backup instances in the storm
     require_catchup=True,
     config_overrides=dict(_CATCHUP_CONFIG)))
+
+
+# --- ordering lanes: faults inside one lane of a laned pool --------------
+#
+# The multi-lane write path's acceptance scenario: the f_crash_partition
+# arc (f staggered crash/restarts, then a half/half partition that
+# heals) applied INSIDE lane 0 of a 4-lane pool. The healthy lanes keep
+# ordering — but only as far as the cross-lane barrier's skew bound
+# (LOG_SIZE past the last sealed window): the continuously-probed
+# cross_lane invariant asserts no lane ever stabilizes a window the
+# barrier hasn't sealed, the seal fingerprint chain stays recomputable,
+# and after the heal every lane resumes (per-lane liveness probes).
+# Tiny checkpoint windows on purpose: the barrier must seal many times
+# DURING the fault, not just at the end.
+
+register(Scenario(
+    name="lane_partition",
+    build=_f_crash_partition,
+    description="f crash/restarts + half/half partition INSIDE lane 0 "
+                "of a 4-lane pool: healthy lanes stall at the barrier's "
+                "skew bound, never past it (cross_lane asserted "
+                "continuously); lane 0's crashed node leeches back "
+                "across GC'd windows and every lane resumes after the "
+                "heal",
+    lanes=4,
+    run_seconds=30.0,
+    liveness_timeout=60.0,
+    # real ledgers: lane 0's crash victim falls behind windows that
+    # stabilize AND GC in its absence (CHK_FREQ=2), so rejoining takes
+    # a real leecher round — the catchup plane must work INSIDE a lane,
+    # with the barrier's lane_caught_up floor riding along; ASSERTED
+    # via the catchup_recovery verdict, not assumed
+    real_execution=True,
+    require_catchup=True,
+    config_overrides={
+        "Max3PCBatchSize": 1,  # checkpoints move per txn
+        "CHK_FREQ": 2,
+        "LOG_SIZE": 6,
+        "CatchupBatchSize": 2,
+        "ConsistencyProofsTimeout": 1.0,
+        "CatchupRequestTimeout": 1.5,
+        "CatchupMaxRetries": 8,
+        # the healthy lanes WILL stall at the skew bound while lane 0
+        # is partitioned — give the stall watchdog room so they don't
+        # churn instance changes against a wait that is by design
+        "OrderingStallTimeout": 10.0,
+    }))
 
 
 # --- the checker-vacuity proof -------------------------------------------

@@ -105,6 +105,16 @@ class MetricsName:
     CATCHUP_PROOFS_VERIFIED = "catchup.proofs_verified"
     CATCHUP_REPS_REJECTED = "catchup.reps_rejected"
     CATCHUP_RETRIES = "catchup.retries"
+    # ordering lanes (keyspace-partitioned write path, lanes/): lane
+    # count (Stat.last), per-lane ordered totals and router assignments
+    # ("<prefix>.<lane>"), the barrier's sealed-window ordinal, and the
+    # seal lag (first lane ready -> all lanes ready, virtual seconds) —
+    # how long the fastest lane waited on the slowest per window
+    LANE_COUNT = "lanes.count"
+    LANE_ORDERED = "lanes.ordered"
+    LANE_ROUTED = "lanes.routed"
+    LANE_SEALED_WINDOW = "lanes.sealed_window"
+    LANE_BARRIER_SEAL_LAG = "lanes.barrier_seal_lag"
     # transport
     ZSTACK_DROPPED = "zstack.dropped"
     # simulation network / chaos plane

@@ -144,6 +144,25 @@ class Config:
     # behaviour). The shed tiebreak shares IngressShedSeed.
     IngressReadQueueCapacity: int = 0
 
+    # --- ordering lanes (lanes/) ------------------------------------------
+    # Keyspace-partitioned write path: the request keyspace splits across
+    # this many independent ordering lanes, each a full master-instance
+    # vote plane on its own slice of the fabric mesh, with a cross-lane
+    # checkpoint barrier keeping state proofs and catchup on one
+    # consistent stabilized window. 0/1 = single-lane (the pre-lanes
+    # behaviour; LanedPool treats both as one lane).
+    OrderingLanes: int = 0
+    # Router law seed (sha256(seed | routing key) % lanes). 0 = simulation
+    # pools fall back to the pool seed, so a seeded run replays the
+    # byte-identical lane assignment.
+    LaneRouterSeed: int = 0
+    # Sealed-window records (per-lane digest lists, per-window chain
+    # values) the barrier retains for verification — the chain TIP is
+    # O(1) state either way. 0 = retain everything (bounded sim runs,
+    # full-chain recomputation in the cross_lane invariant); a deployed
+    # pool should bound this like StateProofCacheWindows.
+    LaneBarrierKeepWindows: int = 0
+
     # --- state-proof plane (proofs/) --------------------------------------
     # Stabilized checkpoint windows whose pool multi-signature stays
     # servable from the CheckpointProofCache; older windows GC with the

@@ -119,6 +119,15 @@ class _Extract:
     def __init__(self, events: List[Dict[str, Any]]):
         self.req: Dict[str, Dict[str, float]] = {}   # digest -> marks
         self.rid_of: Dict[str, str] = {}             # digest -> ident|reqId
+        # ordering lanes: every mark a laned pool records carries
+        # args["lane"] (LaneTraceView), and the cross-lane barrier
+        # stamps barrier.ready/barrier.sealed marks (cat "lanes") —
+        # net-wave joins key on the lane so two lanes both at
+        # (view 0, seq 5) never cross-pollute, and the seal instant
+        # becomes each journey's "barrier" hop
+        self.req_lane: Dict[str, int] = {}           # digest -> lane
+        self._barrier_ready: Dict[tuple, int] = {}   # (lane, win) -> seq
+        self.barrier_sealed: Dict[int, float] = {}   # window -> seal ts
         # batch digest -> {"keys": set[(v, s)], "reqIdr": [...],
         #                  "marks": {name: earliest ts},
         #                  "executed_by": set[node]}
@@ -155,23 +164,39 @@ class _Extract:
             _earliest(marks, name, ts)
             if name == "req.ingress" and args.get("rid"):
                 self.rid_of[key[0]] = args["rid"]
+            if "lane" in args and key[0] not in self.req_lane:
+                self.req_lane[key[0]] = args["lane"]
         elif cat == "3pc" and key and len(key) >= 3 \
                 and name in self._LIFECYCLE:
             b = self.batches.setdefault(
                 key[2], {"keys": set(), "reqIdr": None, "marks": {},
-                         "executed_by": set()})
+                         "executed_by": set(), "lane": None})
             b["keys"].add((key[0], key[1]))
             _earliest(b["marks"], name, ts)
             if name == "3pc.executed":
                 b["executed_by"].add(ev.get("node", ""))
             if args.get("reqIdr") and b["reqIdr"] is None:
                 b["reqIdr"] = list(args["reqIdr"])
+            if "lane" in args and b["lane"] is None:
+                b["lane"] = args["lane"]
+        elif cat == "lanes" and key:
+            if name == "barrier.ready" and args.get("seq") is not None:
+                rkey = (args.get("lane"), key[0])
+                if rkey not in self._barrier_ready:
+                    self._barrier_ready[rkey] = args["seq"]
+            elif name == "barrier.sealed":
+                _earliest(self.barrier_sealed, key[0], ts)
         elif cat == "net":
             op, nid = args.get("m"), args.get("id")
+            lane = args.get("lane")
+            # ids are per-network sequences and each lane runs its own
+            # network, so the send/recv join MUST key on (lane, id) —
+            # bare ids collide across lanes in a merged laned dump
             if name == "net.send":
-                self._send_at[nid] = (ts, op, tuple(key or ()))
+                self._send_at[(lane, nid)] = (
+                    ts, op, (lane,) + tuple(key or ()))
             elif name == "net.recv":
-                sent = self._send_at.pop(nid, None)
+                sent = self._send_at.pop((lane, nid), None)
                 if sent is not None:
                     lat = ts - sent[0]
                     if lat >= 0.0:
@@ -187,9 +212,10 @@ class _Extract:
                     lat = ts - args["sent"]
                     if lat >= 0.0:
                         self.net.setdefault(
-                            (op, tuple(key or ())), []).append(lat)
+                            (op, (lane,) + tuple(key or ())),
+                            []).append(lat)
             elif name == "net.drop":
-                k = (op, tuple(key or ()))
+                k = (op, (lane,) + tuple(key or ()))
                 self.net_drops[k] = self.net_drops.get(k, 0) + 1
         elif cat == "catchup" and key:
             node = ev.get("node", "")
@@ -228,6 +254,21 @@ class _Extract:
             return None
         return percentile(sorted(lats), 50)
 
+    def barrier_seal_ts(self, lane: Optional[int],
+                        seq: int) -> Optional[float]:
+        """Seal instant of the cross-lane window covering lane-local
+        batch ``seq`` (the smallest window whose boundary reaches it),
+        or None when the dump never sealed that far."""
+        if lane is None:
+            return None
+        windows = sorted(
+            window for (ready_lane, window), seq_end
+            in self._barrier_ready.items()
+            if ready_lane == lane and seq_end >= seq)
+        if not windows:
+            return None
+        return self.barrier_sealed.get(windows[0])
+
 
 # ----------------------------------------------------------------------
 # journeys
@@ -235,13 +276,18 @@ class _Extract:
 
 # hop -> which attribution bucket its residual (after the network share)
 # lands in; the ``order`` hop is the dispatch-tick / in-order wait and
-# charges to ``device`` when the dump shows a tick-batched plane
+# charges to ``device`` when the dump shows a tick-batched plane. The
+# ``barrier`` hop (ordering lanes: executed -> the cross-lane seal of
+# the batch's checkpoint window) exists only in laned dumps and — like
+# ``admission`` — is skipped, not counted incomplete, when absent.
 _HOPS = ("admission", "auth", "batching", "preprepare", "prepare",
-         "commit", "order", "execute")
+         "commit", "order", "execute", "barrier")
+_OPTIONAL_HOPS = ("admission", "barrier")
 _RESIDUAL_OF = {"admission": "queue", "auth": "compute",
                 "batching": "queue", "preprepare": "queue",
                 "prepare": "queue", "commit": "queue",
-                "order": "queue", "execute": "compute"}
+                "order": "queue", "execute": "compute",
+                "barrier": "queue"}
 _WAVE_OF = {"preprepare": "PREPREPARE", "prepare": "PREPARE",
             "commit": "COMMIT"}
 
@@ -275,10 +321,14 @@ def _build_journeys(events: List[Dict[str, Any]]
         t_sent = marks.get("3pc.preprepare_sent")
         t_pp = marks.get("3pc.preprepare", t_sent)
         batch_key = min(b["keys"])
-        wave_med = {hop: x.net_median(op, batch_key)
+        lane = b.get("lane")
+        # net-wave samples are keyed (lane, view, seq): an unlaned dump
+        # stores lane None on both sides, so the join shape is uniform
+        wave_med = {hop: x.net_median(op, (lane,) + batch_key)
                     for hop, op in _WAVE_OF.items()}
         t_ord = marks.get("3pc.ordered")
         t_exe = marks["3pc.executed"]
+        t_seal = x.barrier_seal_ts(lane, batch_key[1])
         leeched_by = sorted(
             node for node, rounds in x.catchup.items()
             if node not in b["executed_by"]
@@ -307,9 +357,15 @@ def _build_journeys(events: List[Dict[str, Any]]
                            marks.get("3pc.commit_quorum")),
                 "order": (marks.get("3pc.commit_quorum"), t_ord),
                 "execute": (t_ord, t_exe),
+                # cross-lane barrier (ordering lanes): executed -> the
+                # seal of the batch's checkpoint window across ALL
+                # lanes; absent in single-lane dumps and for windows
+                # the dump never sealed
+                "barrier": ((t_exe, t_seal) if t_seal is not None
+                            else None),
             }
             rid = x.rid_of.get(digest)
-            prop_med = (x.net_median("PROPAGATE", (rid,))
+            prop_med = (x.net_median("PROPAGATE", (lane, rid))
                         if rid else None)
             tid = trace_id(digest)
             hops = []
@@ -318,8 +374,8 @@ def _build_journeys(events: List[Dict[str, Any]]
             complete = True
             for hop in _HOPS:
                 span = chain[hop]
-                if hop == "admission" and span is None:
-                    continue  # admission control off: no wait to split
+                if hop in _OPTIONAL_HOPS and span is None:
+                    continue  # plane off in this dump: no wait to split
                 if span is None or span[0] is None or span[1] is None:
                     complete = False
                     continue
@@ -344,6 +400,9 @@ def _build_journeys(events: List[Dict[str, Any]]
                 "trace_id": tid,
                 "class": "write",
                 "batch": [batch_key[0], batch_key[1], bd],
+                # ordering lanes: which lane ordered it (absent in
+                # single-lane dumps — existing tables stay byte-stable)
+                **({"lane": lane} if lane is not None else {}),
                 "t_ingress": _r(t_ing),
                 "e2e": _r(t_exe - t_ing) if complete else None,
                 "hops": hops,
@@ -432,6 +491,24 @@ def journey_summary(events: List[Dict[str, Any]],
         "critical_path": {h: dominant[h] for h in _HOPS
                           if h in dominant},
     }
+    # ordering lanes: per-lane e2e percentiles + barrier-hop coverage
+    # (absent for single-lane dumps — existing rollups stay byte-stable)
+    lane_ids = sorted({j["lane"] for j in journeys if "lane" in j})
+    if lane_ids:
+        out["lanes"] = {
+            "count": len(lane_ids),
+            "journeys_per_lane": {
+                str(l): sum(1 for j in journeys if j.get("lane") == l)
+                for l in lane_ids},
+            "e2e_per_lane": {
+                str(l): _pct_block([j["e2e"] for j in complete
+                                    if j.get("lane") == l])
+                for l in lane_ids},
+            "with_lane": sum(1 for j in journeys if "lane" in j),
+            "with_barrier_hop": sum(
+                1 for j in journeys
+                if any(h["hop"] == "barrier" for h in j["hops"])),
+        }
     windows = built["fault_windows"]
     if windows:
         def _in_fault(j):
@@ -467,9 +544,12 @@ def journey_for(events: List[Dict[str, Any]],
     digest = journey["digest"]
     batch_digest = journey["batch"][2]
     tid = journey["trace_id"]
+    lane = journey.get("lane")
     per_node: List[Dict[str, Any]] = []
     waves: Dict[str, List[float]] = {}
     batch_key = tuple(journey["batch"][:2])
+    # wave samples are keyed (lane, view, seq) — None lane for unlaned
+    wave_key = (lane,) + batch_key
     for ev in events:
         key = ev.get("key")
         cat = ev.get("cat", "")
@@ -489,14 +569,14 @@ def journey_for(events: List[Dict[str, Any]],
             if ev["name"] == "net.recv":
                 waves.setdefault(args.get("m", "?"), [])
     for op in list(waves) + ["PREPREPARE", "PREPARE", "COMMIT"]:
-        lats = x.net.get((op, batch_key))
+        lats = x.net.get((op, wave_key))
         if lats:
             waves[op] = [_r(v) for v in lats]
     # the PROPAGATE wave is keyed by the ingress rid, not the batch key
     # — it feeds the auth hop's network share, so it belongs here too
     rid = x.rid_of.get(digest)
     if rid is not None:
-        lats = x.net.get(("PROPAGATE", (rid,)))
+        lats = x.net.get(("PROPAGATE", (lane, rid)))
         if lats:
             waves["PROPAGATE"] = [_r(v) for v in lats]
     per_node.sort(key=lambda r: (r["ts"], r["node"], r["name"]))

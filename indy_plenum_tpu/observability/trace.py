@@ -230,6 +230,50 @@ class NullTraceRecorder(TraceRecorder):
 NULL_TRACE = NullTraceRecorder()
 
 
+class LaneTraceView:
+    """A lane's view onto the pool-shared recorder (ordering lanes).
+
+    Every event recorded through the view carries ``args["lane"]``, so
+    one merged dump still attributes each mark — request lifecycle, 3PC
+    waves, net send/recv — to the ordering lane that produced it (the
+    causal plane keys its wave joins on it: two lanes both at
+    ``(view 0, seq 5)`` must never cross-pollute each other's latency
+    samples). Everything else (ring, clock, dumps, journey-rollup cache)
+    delegates to the wrapped recorder, so ``trace_hash``/``to_jsonl``
+    cover the whole pool regardless of which view a caller holds."""
+
+    def __init__(self, base: TraceRecorder, lane: int):
+        self._base = base
+        self.lane = lane
+        self.enabled = base.enabled
+
+    def _tag(self, args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        tagged = {"lane": self.lane}
+        if args:
+            tagged.update(args)
+        return tagged
+
+    def record(self, name: str, cat: str = "3pc", node: str = "",
+               key: Optional[Sequence] = None, dur: Optional[float] = None,
+               args: Optional[Dict[str, Any]] = None,
+               ts: Optional[float] = None) -> None:
+        self._base.record(name, cat=cat, node=node, key=key, dur=dur,
+                          args=self._tag(args), ts=ts)
+
+    def span(self, name: str, cat: str = "dispatch", node: str = "",
+             args: Optional[Dict[str, Any]] = None):
+        return self._base.span(name, cat=cat, node=node,
+                               args=self._tag(args))
+
+    def trigger_dump(self, reason: str, node: str = "",
+                     args: Optional[Dict[str, Any]] = None) -> dict:
+        return self._base.trigger_dump(reason, node=node,
+                                       args=self._tag(args))
+
+    def __getattr__(self, item):
+        return getattr(self._base, item)
+
+
 # ----------------------------------------------------------------------
 # serialization
 # ----------------------------------------------------------------------
@@ -586,10 +630,16 @@ def to_chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             rec["args"] = args
         is_net_mark = (ev.get("cat") == "net"
                        and ev["name"] in ("net.send", "net.recv"))
+        # cross-lane checkpoint barrier (ordering lanes): each lane's
+        # readiness mark flows into the seal mark, so Perfetto draws the
+        # K-way barrier join as arrows converging on barrier.sealed
+        is_barrier_mark = (ev.get("cat") == "lanes"
+                           and ev["name"] in ("barrier.ready",
+                                              "barrier.sealed"))
         if ev.get("dur") is not None:
             rec["ph"] = "X"
             rec["dur"] = round(ev["dur"] * 1e6, 3)
-        elif is_net_mark:
+        elif is_net_mark or is_barrier_mark:
             # flow ends must bind to an ENCLOSING duration slice per the
             # trace-event spec — an instant can't anchor an arrow — so
             # transport marks render as 1µs slices
@@ -613,6 +663,31 @@ def to_chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "name": "net." + str((ev.get("args") or {})
                                          .get("m", "msg")),
                     "cat": "net",
+                    "pid": rec["pid"],
+                    "tid": rec["tid"],
+                    "ts": rec["ts"],
+                })
+        elif is_barrier_mark and ev.get("key"):
+            window = ev["key"][0]
+            bargs = ev.get("args") or {}
+            if ev["name"] == "barrier.ready":
+                flow_ids = ["barrier-%s-%s" % (window, bargs.get("lane"))]
+            else:
+                # sealed: close one arc per lane that actually emitted a
+                # readiness mark for this window — idle/skipped lanes
+                # have no flow start, and a dangling end renders broken
+                ready = bargs.get("ready_lanes")
+                if ready is None:  # older dumps: best-effort all lanes
+                    ready = range(int(bargs.get("lanes", 0)))
+                flow_ids = ["barrier-%s-%s" % (window, lane)
+                            for lane in ready]
+            for fid in flow_ids:
+                out.append({
+                    "ph": "s" if ev["name"] == "barrier.ready" else "f",
+                    "bp": "e",
+                    "id": fid,
+                    "name": "barrier.window",
+                    "cat": "lanes",
                     "pid": rec["pid"],
                     "tid": rec["tid"],
                     "ts": rec["ts"],

@@ -271,6 +271,42 @@ class Monitor:
                     catchup[label] = int(stat.total)
             if catchup:
                 snap["catchup"] = catchup
+            # ordering lanes (lanes/): lane count, per-lane ordered
+            # totals and router assignments, and the cross-lane
+            # barrier's sealed window + seal lag (first lane ready ->
+            # sealed, virtual seconds) — absent entirely when the run
+            # never recorded lane metrics (single-lane pools)
+            lane_count = self._metrics.stat(MetricsName.LANE_COUNT)
+            if lane_count is not None and lane_count.last:
+                n_lanes = int(lane_count.last)
+                lanes: Dict[str, object] = {"count": n_lanes}
+                ordered, routed = [], []
+                for li in range(n_lanes):
+                    stat = self._metrics.stat(
+                        f"{MetricsName.LANE_ORDERED}.{li}")
+                    ordered.append(int(stat.last) if stat else 0)
+                    stat = self._metrics.stat(
+                        f"{MetricsName.LANE_ROUTED}.{li}")
+                    routed.append(int(stat.total) if stat else 0)
+                lanes["ordered_per_lane"] = ordered
+                lanes["router_distribution"] = routed
+                barrier = {}
+                sealed = self._metrics.stat(
+                    MetricsName.LANE_SEALED_WINDOW)
+                if sealed is not None:
+                    barrier["sealed_window"] = int(sealed.last)
+                    barrier["seals"] = sealed.count
+                lag = self._metrics.stat(
+                    MetricsName.LANE_BARRIER_SEAL_LAG)
+                if lag is not None:
+                    barrier["seal_lag"] = {
+                        "last": round(lag.last, 6),
+                        "avg": round(lag.avg, 6),
+                        "max": round(lag.max, 6),
+                    }
+                if barrier:
+                    lanes["barrier"] = barrier
+                snap["lanes"] = lanes
         if self._trace is not None and self._trace.enabled:
             # per-phase latency attribution (flight recorder): where this
             # node's ordered batches spent their time — prepare / commit

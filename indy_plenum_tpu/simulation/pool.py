@@ -146,7 +146,8 @@ class SimNode:
                  domain_genesis: Optional[list] = None,
                  storage=None, bls_keys=None,
                  shadow_check: Optional[bool] = None,
-                 vote_plane=None, trace=None, metrics=None):
+                 vote_plane=None, trace=None, metrics=None,
+                 barrier=None, lane: int = 0):
         # shadow_check default: on whenever the device plane decides, so
         # tests continuously prove host/device equivalence. The bench turns
         # it off to run the device plane as the SOLE quorum authority.
@@ -251,7 +252,8 @@ class SimNode:
             data=self.data, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher3pc,
             config=config,
-            vote_plane=self.vote_plane, shadow_check=shadow_check)
+            vote_plane=self.vote_plane, shadow_check=shadow_check,
+            barrier=barrier, lane=lane)
         self.view_changer = ViewChangeService(
             data=self.data, timer=timer, bus=self.internal_bus,
             network=self.external_bus, stasher=self.stasher,
@@ -391,21 +393,39 @@ class SimPool:
                  host_eval: bool = False,
                  spy: bool = False,
                  trace: bool = False,
-                 trace_capacity: Optional[int] = None):
+                 trace_capacity: Optional[int] = None,
+                 timer: Optional[MockTimer] = None,
+                 metrics: Optional[MetricsCollector] = None,
+                 trace_recorder=None,
+                 drive_ticks: bool = True,
+                 barrier=None,
+                 lane: int = 0):
+        # injection seams (ordering lanes, lanes/pool.py): a LanedPool
+        # composes K SimPools as lanes on ONE shared timer / metrics
+        # collector / flight-recorder ring (each lane recording through
+        # its LaneTraceView), with the cross-lane checkpoint barrier
+        # threaded into every lane's CheckpointService and the pool-level
+        # tick replaced by the multi-lane driver (drive_ticks=False).
         self.config = config or getConfig(
             {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10})
         self.seed = seed
-        self.timer = MockTimer(start_time=1_700_000_000.0)
-        self.metrics = MetricsCollector()
+        self.timer = timer if timer is not None \
+            else MockTimer(start_time=1_700_000_000.0)
+        self.metrics = metrics if metrics is not None else MetricsCollector()
+        self.lane = lane
         # consensus flight recorder: one pool-shared ring on the VIRTUAL
         # clock, so a seeded run (chaos and mesh runs included) dumps a
         # bit-identical trace — checkable like ordered_hash()
         from ..observability.trace import NULL_TRACE, TraceRecorder
 
-        self.trace = (TraceRecorder(
-            self.timer.get_current_time,
-            capacity=trace_capacity or self.config.TraceRecorderCapacity)
-            if trace else NULL_TRACE)
+        if trace_recorder is not None:
+            self.trace = trace_recorder
+        else:
+            self.trace = (TraceRecorder(
+                self.timer.get_current_time,
+                capacity=trace_capacity
+                or self.config.TraceRecorderCapacity)
+                if trace else NULL_TRACE)
         # causal tracing plane: the network stamps net.send/net.recv
         # marks on the same recorder, so cross-node journeys carry
         # measured (delayer-inclusive) per-hop network latency
@@ -493,7 +513,8 @@ class SimPool:
                     bls_keys=self.bls_keys, shadow_check=shadow_check,
                     vote_plane=(self.vote_group.view(i * k)
                                 if self.vote_group else None),
-                    trace=self.trace, metrics=self.metrics)
+                    trace=self.trace, metrics=self.metrics,
+                    barrier=barrier, lane=lane)
             for i, name in enumerate(self.validators)]
         self.network.connect_all()
 
@@ -572,12 +593,14 @@ class SimPool:
         # interval get ONE device batch verify at tick start.
         self._last_ingress_depth = 0
         self._last_ingress_shed = 0
+        # drive_ticks=False: a composing driver (the multi-lane tick in
+        # quorum_driver.drive_lane_ticks) owns the pool-level tick
         self._quorum_tick_timer = drive_group_ticks(
             self.timer, self.config, self.vote_group, self.nodes,
             accounting=self.host_seconds,
             ingress=(self._ingress_tick if self.authnr is not None
                      else None),
-            trace=self.trace)
+            trace=self.trace) if drive_ticks else None
         # adaptive tick mode: the governor's interval trajectory is a
         # first-class observable (bench digests, determinism tests)
         self.governor = getattr(self._quorum_tick_timer, "governor", None)
@@ -629,11 +652,11 @@ class SimPool:
     def primary(self) -> SimNode:
         return self.node(self.nodes[0].data.primaries[0])
 
-    def submit_request(self, seq: int,
-                       client_id: Optional[str] = None) -> Request:
-        # client_id: the ingress plane's virtual-client identity — the
-        # admission controller's per-client fairness cap keys on it
-        # (None = anonymous, outside any cap)
+    def build_request(self, seq: int) -> Request:
+        """Construct (but do not submit) the pool's standard request for
+        ``seq`` — the seam the lane router needs: a LanedPool builds the
+        request first, routes it by its key, THEN submits it to the
+        owning lane (``submit_built``)."""
         if self.real_execution:
             from ..common.constants import NYM, TARGET_NYM, TXN_TYPE, VERKEY
             from ..crypto.signers import DidSigner
@@ -648,6 +671,17 @@ class SimPool:
         else:
             req = Request(identifier="client1", reqId=seq,
                           operation={"type": "1", "v": seq})
+        return req
+
+    def submit_request(self, seq: int,
+                       client_id: Optional[str] = None) -> Request:
+        # client_id: the ingress plane's virtual-client identity — the
+        # admission controller's per-client fairness cap keys on it
+        # (None = anonymous, outside any cap)
+        return self.submit_built(self.build_request(seq), client_id)
+
+    def submit_built(self, req: Request,
+                     client_id: Optional[str] = None) -> Request:
         if self.trace.enabled:
             self.trace.record("req.ingress", cat="req", key=(req.digest,))
         if self.sign_requests:

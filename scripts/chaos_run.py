@@ -58,6 +58,11 @@ def main() -> int:
                              "x validator 2-axis fabric (e.g. 2x2); "
                              "requires --device-quorum; on CPU the host "
                              "platform self-provisions virtual devices")
+    parser.add_argument("--lanes", type=int, default=0,
+                        help="override the scenario's ordering-lane "
+                             "count (> 1 runs the laned path: faults "
+                             "inside lane 0, cross_lane invariant "
+                             "probed; 0 keeps the scenario's own value)")
     parser.add_argument("--trace", action="store_true",
                         help="arm the consensus flight recorder: the "
                              "report gains trace_hash + flight_recorder "
@@ -107,6 +112,8 @@ def main() -> int:
             tags = []
             if sc.expect_fail:
                 tags.append("expects FAIL: " + ", ".join(sc.expect_fail))
+            if sc.lanes > 1:
+                tags.append(f"laned x{sc.lanes}; asserts cross_lane")
             if sc.real_execution:
                 extra = [flag for flag, on in (
                     ("catchup", sc.require_catchup),
@@ -121,7 +128,15 @@ def main() -> int:
         return 0
 
     out = args.out or f"chaos_{args.scenario}_{args.seed}.json"
-    report = run_scenario(args.scenario, seed=args.seed,
+    scenario = args.scenario
+    if args.lanes:
+        import dataclasses
+
+        from indy_plenum_tpu.chaos.scenarios import get_scenario
+
+        scenario = dataclasses.replace(get_scenario(args.scenario),
+                                       lanes=args.lanes)
+    report = run_scenario(scenario, seed=args.seed,
                           n_nodes=args.nodes, out_path=out,
                           device_quorum=args.device_quorum,
                           quorum_tick_interval=args.tick,

@@ -84,6 +84,16 @@ and execution across the pool), if the traced ordered digests diverge
 from the untraced run, or if e2e p99 (client ingress -> executed,
 virtual protocol time) exceeds ``--e2e-budget``.
 
+Lanes gate (PR 14): unless ``--no-lanes-gate``, the script runs the
+same routed workload through 1 and 4 ordering lanes (n=4 per lane,
+tiny checkpoint windows so the cross-lane barrier seals continuously)
+and fails unless the 4-lane arm's ordered/sim-second clears the
+``--lanes-speedup-floor`` (3.0x), a 4-lane replay is byte-identical
+(per-lane ordered hashes, the sealed-window fingerprint chain tip, the
+journey table), no journey is orphaned, and every journey names its
+lane and carries the barrier hop. The latency gate additionally
+asserts per-lane e2e p99 at 4 lanes.
+
 Static gate (PR 13): unless ``--no-static-gate``, the pure-AST
 determinism & hot-path analyzer (``indy_plenum_tpu.analysis``) runs
 over the whole package TWICE and fails if any unsuppressed finding
@@ -815,8 +825,132 @@ def catchup_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def measure_laned(lanes: int, n_nodes: int, txns_per_lane: int,
+                  tick: float, seed: int) -> dict:
+    """One laned measurement (ordering lanes, ISSUE 14): K full
+    ordering lanes (per-lane vote plane groups, one shared tick,
+    adaptive governor) under the cross-lane checkpoint barrier with
+    tiny windows, traced, routed client traffic, then a seal flush so
+    every journey's window seals. Throughput is ordered txns per SIM
+    second — the protocol-time rate the lanes add up to."""
+    from indy_plenum_tpu.lanes import LanedPool
+    from indy_plenum_tpu.observability.causal import journey_summary
+
+    config = getConfig({
+        "Max3PCBatchSize": 5,
+        "Max3PCBatchWait": 0.05,
+        "CHK_FREQ": 2,
+        "LOG_SIZE": 6,
+        "QuorumTickInterval": tick,
+        "QuorumTickAdaptive": True,
+    })
+    pool = LanedPool(lanes=lanes, n_nodes=n_nodes, seed=seed,
+                     config=config, device_quorum=True, trace=True)
+    total = txns_per_lane * lanes
+    sim_t0 = pool.timer.get_current_time()
+    for i in range(total):
+        pool.submit_request(i)
+    deadline = time.monotonic() + 240
+    while pool.ordered_total() < total and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert pool.ordered_total() >= total, \
+        f"laned run stalled at {pool.ordered_total()}/{total}"
+    assert pool.honest_nodes_agree()
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
+    pads = pool.seal_flush()
+    js = journey_summary(pool.trace.events())
+    lanes_js = js.get("lanes") or {}
+    return {
+        "lanes": lanes,
+        "n_per_lane": n_nodes,
+        "txns_ordered": total,
+        "ordered_per_sim_second": round(total / sim_elapsed, 2),
+        "sim_elapsed": round(sim_elapsed, 3),
+        "router_distribution": list(pool.router.distribution),
+        "ordered_hash_per_lane": pool.ordered_hashes(),
+        "sealed_window": pool.barrier.sealed_window,
+        "sealed_fingerprint": pool.sealed_fingerprint,
+        "seal_pads": pads,
+        "journey_hash": js["journey_hash"],
+        "journeys_count": js["count"],
+        "journeys_complete": js["complete"],
+        "orphan_spans": js["orphan_spans"],
+        "with_lane": lanes_js.get("with_lane", 0),
+        "with_barrier_hop": lanes_js.get("with_barrier_hop", 0),
+        "e2e_per_lane": lanes_js.get("e2e_per_lane") or {},
+    }
+
+
+def lanes_gate(args) -> "tuple[dict, list]":
+    """Multi-lane ordering gate (ISSUE 14): on the SAME seed,
+
+    1. the 4-lane arm's ordered/sim-second must be at least
+       ``--lanes-speedup-floor`` (3.0) times the 1-lane arm's — the
+       write path scales horizontally, barrier included;
+    2. a 4-lane replay must be BYTE-IDENTICAL: per-lane
+       ``ordered_hash``es, the sealed-window fingerprint chain tip, and
+       the journey table fingerprint;
+    3. zero orphan journeys, and EVERY journey names its lane and
+       carries the cross-lane barrier hop (seal coverage is total after
+       the seal flush).
+    """
+    one = measure_laned(1, args.lanes_nodes, args.lanes_txns,
+                        args.tick, seed=args.seed)
+    four = measure_laned(4, args.lanes_nodes, args.lanes_txns,
+                         args.tick, seed=args.seed)
+    replay = measure_laned(4, args.lanes_nodes, args.lanes_txns,
+                           args.tick, seed=args.seed)
+    failures = []
+    speedup = (four["ordered_per_sim_second"]
+               / one["ordered_per_sim_second"])
+    if speedup < args.lanes_speedup_floor:
+        failures.append(
+            f"4-lane ordered/sim-sec speedup {speedup:.2f} below the "
+            f"{args.lanes_speedup_floor}x floor "
+            f"({four['ordered_per_sim_second']} vs "
+            f"{one['ordered_per_sim_second']})")
+    if replay["ordered_hash_per_lane"] != four["ordered_hash_per_lane"]:
+        failures.append("per-lane ordered hashes diverge across "
+                        "identical seeded 4-lane runs")
+    if replay["sealed_fingerprint"] != four["sealed_fingerprint"]:
+        failures.append("sealed-window fingerprint diverges across "
+                        "identical seeded 4-lane runs")
+    if replay["journey_hash"] != four["journey_hash"]:
+        failures.append("laned journey tables diverge across identical "
+                        "seeded 4-lane runs")
+    for arm, label in ((one, "1-lane"), (four, "4-lane")):
+        if arm["orphan_spans"] > 0 \
+                or arm["journeys_complete"] != arm["journeys_count"]:
+            failures.append(
+                f"{label}: {arm['orphan_spans']} orphan journeys "
+                f"({arm['journeys_complete']}/{arm['journeys_count']} "
+                f"complete)")
+        if arm["with_lane"] != arm["journeys_count"]:
+            failures.append(
+                f"{label}: only {arm['with_lane']} of "
+                f"{arm['journeys_count']} journeys name their lane")
+        if arm["with_barrier_hop"] != arm["journeys_count"]:
+            failures.append(
+                f"{label}: only {arm['with_barrier_hop']} of "
+                f"{arm['journeys_count']} journeys carry the barrier "
+                f"hop")
+    record = {
+        "one_lane": one,
+        "four_lane": four,
+        "replay_identical": (
+            replay["ordered_hash_per_lane"]
+            == four["ordered_hash_per_lane"]
+            and replay["sealed_fingerprint"] == four["sealed_fingerprint"]
+            and replay["journey_hash"] == four["journey_hash"]),
+        "speedup_4_lanes": round(speedup, 3),
+        "speedup_floor": args.lanes_speedup_floor,
+    }
+    return record, failures
+
+
 def latency_gate(args, traced: "dict | None" = None,
-                 base: "dict | None" = None) -> "tuple[dict, list]":
+                 base: "dict | None" = None,
+                 laned: "dict | None" = None) -> "tuple[dict, list]":
     """End-to-end latency gate (causal tracing plane, ISSUE 12): on the
     SAME n=16/k=6 workload and seed,
 
@@ -831,11 +965,17 @@ def latency_gate(args, traced: "dict | None" = None,
        tracing gate, re-asserted here because this gate can run alone
        via ``--only latency``);
     4. e2e p99 (client ingress -> executed, VIRTUAL protocol time) is
-       recorded against ``--e2e-budget`` and fails the gate when over.
+       recorded against ``--e2e-budget`` and fails the gate when over;
+    5. (journeys phase 2, ISSUE 14) at 4 ordering lanes: zero orphan
+       journeys and EVERY lane's e2e p99 within the same budget.
 
-    ``traced``/``base`` reuse the tracing gate's runs (identical
-    arguments) so the default full-script invocation pays only ONE
-    extra traced run (the byte-identity replay)."""
+    ``traced``/``base`` reuse the tracing gate's runs and ``laned``
+    the lanes gate's 4-lane arm (identical arguments) so the default
+    full-script invocation pays only ONE extra traced run (the
+    byte-identity replay)."""
+    if laned is None:
+        laned = measure_laned(4, args.lanes_nodes, args.lanes_txns,
+                              args.tick, seed=args.seed)
     if traced is None:
         traced = measure(args.sharded_nodes, args.sharded_instances,
                          args.batches, args.batch_size, args.tick,
@@ -869,6 +1009,21 @@ def latency_gate(args, traced: "dict | None" = None,
     if p99 > args.e2e_budget:
         failures.append(f"e2e p99 {p99} sim-seconds over budget "
                         f"{args.e2e_budget}")
+    # journeys phase 2 (ordering lanes): at 4 lanes, zero orphans and
+    # per-lane e2e p99 inside the same budget
+    if laned["orphan_spans"] > 0 \
+            or laned["journeys_complete"] != laned["journeys_count"]:
+        failures.append(
+            f"4-lane run left {laned['orphan_spans']} orphan journeys "
+            f"({laned['journeys_complete']}/{laned['journeys_count']} "
+            f"complete)")
+    lane_p99 = {lane: block["p99"]
+                for lane, block in sorted(laned["e2e_per_lane"].items())}
+    for lane, value in lane_p99.items():
+        if value > args.e2e_budget:
+            failures.append(
+                f"lane {lane} e2e p99 {value} sim-seconds over budget "
+                f"{args.e2e_budget}")
     record = {
         "traced": traced,
         "replay_journey_hash": j2["journey_hash"],
@@ -878,6 +1033,8 @@ def latency_gate(args, traced: "dict | None" = None,
         "e2e": j["e2e"],
         "e2e_budget": args.e2e_budget,
         "attribution_share": j["attribution_share"],
+        "laned_e2e_p99_per_lane": lane_p99,
+        "laned_orphan_spans": laned["orphan_spans"],
     }
     return record, failures
 
@@ -947,9 +1104,12 @@ GATES = {
     "ingress": ("no_ingress_gate", "open-loop saturation/admission"),
     "proof": ("no_proof_gate", "state-proof plane (BLS, zero pairings)"),
     "catchup": ("no_catchup_gate", "chaos-hardened catchup recovery"),
+    "lanes": ("no_lanes_gate",
+              "multi-lane ordering: 1-vs-4-lane scaling floor, "
+              "byte-identical replay, lane+barrier journey coverage"),
     "latency": ("no_latency_gate",
                 "causal journeys: byte-identical tables, zero orphans, "
-                "e2e p99 budget"),
+                "e2e p99 budget (pool-wide + per-lane at 4 lanes)"),
 }
 
 
@@ -988,6 +1148,17 @@ def main() -> int:
                          "(GC-crossing crash/restart verdicts, ledger "
                          "bit-identity, byte-identical replay, byzantine "
                          "seeder rejection)")
+    ap.add_argument("--no-lanes-gate", action="store_true",
+                    help="skip the multi-lane ordering gate (1-vs-4-"
+                         "lane scaling floor, byte-identical replay, "
+                         "lane + barrier-hop journey coverage)")
+    ap.add_argument("--lanes-speedup-floor", type=float, default=3.0,
+                    help="min 4-lane vs 1-lane ordered/sim-second "
+                         "ratio the lanes gate accepts")
+    ap.add_argument("--lanes-nodes", type=int, default=4,
+                    help="validators PER LANE for the lanes gate")
+    ap.add_argument("--lanes-txns", type=int, default=40,
+                    help="routed txns per lane for the lanes gate")
     ap.add_argument("--no-latency-gate", action="store_true",
                     help="skip the causal-journey latency gate "
                          "(byte-identical journey tables, zero orphan "
@@ -1115,9 +1286,17 @@ def main() -> int:
         over.extend(failures)
         # same args as the latency gate's first traced arm — reuse it
         traced_run = record.get("traced")
+    laned_run = None
+    if not args.no_lanes_gate:
+        record, failures = lanes_gate(args)
+        result["lanes_gate"] = record
+        over.extend(failures)
+        # same args as the latency gate's 4-lane rider — reuse it
+        laned_run = record.get("four_lane")
     if not args.no_latency_gate:
         record, failures = latency_gate(args, traced=traced_run,
-                                        base=sharded_single)
+                                        base=sharded_single,
+                                        laned=laned_run)
         result["latency_gate"] = record
         over.extend(failures)
     if not args.no_readback_gate:

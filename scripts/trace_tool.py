@@ -76,8 +76,9 @@ def _flight_events(events) -> list:
 
 def _print_journey(detail: dict) -> None:
     j = detail["journey"]
+    lane = f" lane={j['lane']}" if "lane" in j else ""
     print(f"journey {j['digest'][:16]}… trace_id={j['trace_id']} "
-          f"class={j['class']} batch=(v{j['batch'][0]} "
+          f"class={j['class']}{lane} batch=(v{j['batch'][0]} "
           f"s{j['batch'][1]} {str(j['batch'][2])[:12]}…)")
     print(f"  e2e={j['e2e']} complete={j['complete']} "
           f"attribution={j['attribution']}"
@@ -123,6 +124,15 @@ def _print_journey_table(record: dict) -> None:
     if js.get("critical_path"):
         print("  dominant hop: " + "  ".join(
             f"{k}={v}" for k, v in js["critical_path"].items()))
+    lanes = js.get("lanes")
+    if lanes:
+        per = "  ".join(
+            f"L{l}:n={lanes['journeys_per_lane'][l]}"
+            f",p99={lanes['e2e_per_lane'][l]['p99']}"
+            for l in sorted(lanes["journeys_per_lane"], key=int))
+        print(f"  lanes: {lanes['count']} "
+              f"(barrier hop on {lanes['with_barrier_hop']}"
+              f"/{lanes['with_lane']})  {per}")
     fw = js.get("fault_window")
     if fw:
         print(f"  fault windows: {fw['windows']} — "
@@ -133,7 +143,8 @@ def _print_journey_table(record: dict) -> None:
         mark = "" if j["complete"] else "  INCOMPLETE"
         catchup = (" catchup=" + ",".join(j["catchup"])
                    if j.get("catchup") else "")
-        print(f"  {j['digest'][:16]}… e2e={j['e2e']} "
+        lane = f"lane={j['lane']} " if "lane" in j else ""
+        print(f"  {j['digest'][:16]}… {lane}e2e={j['e2e']} "
               f"batch=v{j['batch'][0]}s{j['batch'][1]} "
               f"net={j['attribution']['network']} "
               f"queue={j['attribution']['queue']} "
@@ -161,6 +172,10 @@ def main() -> int:
                     help="one request's full cross-node path (digest "
                          "prefix ok): per-node marks, span ids, per-hop "
                          "attribution, per-wave network samples")
+    ap.add_argument("--lane", type=int, default=None, metavar="L",
+                    help="restrict the --journeys table to one ordering "
+                         "lane (laned dumps tag every journey with its "
+                         "lane; the summary rollup stays pool-wide)")
     ap.add_argument("--chrome", metavar="OUT",
                     help="write Chrome trace-event JSON (Perfetto)")
     ap.add_argument("--node", default=None,
@@ -205,7 +220,10 @@ def main() -> int:
         built = build_journeys(events)
         record["journeys"] = journey_summary(events, built=built)
         if args.journeys:
-            record["journey_table"] = built["journeys"]
+            table = built["journeys"]
+            if args.lane is not None:
+                table = [j for j in table if j.get("lane") == args.lane]
+            record["journey_table"] = table
     if not view_selected:
         record["flight_events"] = _flight_events(events)
     if args.chrome:

@@ -1152,13 +1152,175 @@ def _run_saturation(serve_reads: bool, seed: int = 29) -> dict:
     }
 
 
+def _run_overload(retry: bool, seed: int = 37) -> dict:
+    """One flash-crowd arm (overload robustness plane): a steady
+    sub-saturation base rate with a hard crowd spike in the middle,
+    reads served through the proof path throughout. ``retry`` arms the
+    closed loop (seeded-backoff re-offers of everything shed) — the arm
+    real overload actually looks like; the open-loop arm is the
+    comparison baseline. Both arms consume the identical RNG stream, so
+    goodput/recovery comparisons are exact. Measured per arm: ordered
+    rate BEFORE the spike vs AFTER it ends (metastable collapse would
+    show as a post-spike rate that never recovers), unique-request
+    goodput, the first-attempt vs retry admission split, and the
+    shed/retry/ordered fingerprints the overload gate replays."""
+    from indy_plenum_tpu.common.metrics_collector import MetricsName
+    from indy_plenum_tpu.config import getConfig
+    from indy_plenum_tpu.ingress import (
+        ReadService,
+        StaticCorpusBacking,
+        WorkloadGenerator,
+        WorkloadProfile,
+        WorkloadSpec,
+    )
+    from indy_plenum_tpu.simulation.pool import SimPool
+
+    # capacity 12 against a 800/s spike: even at the governor's tick
+    # floor (0.025s -> 20 arrivals/tick) the crowd overflows the queue,
+    # so the shed law + retry storm genuinely engage; the 100/s base
+    # rate drains comfortably
+    n_nodes, capacity, n_keys = 8, 12, 4096
+    base_rate, duration = 100.0, 9.0
+    flash_at, flash_dur, peak = 3.0, 1.5, 8.0
+    warm = capacity - 8
+    config = getConfig({
+        "Max3PCBatchSize": 40,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": 0.1,
+        "QuorumTickAdaptive": True,
+        "IngressQueueCapacity": capacity,
+        "IngressRetryMax": 4 if retry else 0,
+        "IngressRetryBase": 0.2,
+        "IngressRetryBackoffMult": 2.0,
+        "IngressRetryBackoffMax": 2.0,
+    })
+    pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   sign_requests=True, trace=True,
+                   trace_capacity=1 << 20)
+    reads = ReadService(StaticCorpusBacking(n_keys, seed=seed),
+                        clock=pool.timer.get_current_time,
+                        metrics=pool.metrics, trace=pool.trace)
+    # warm-up outside the measured window: a sub-capacity ordered wave +
+    # one read drain compile the shapes the arms will hit
+    for i in range(warm):
+        pool.submit_request(2_000_000 + i, client_id="warm")
+    deadline = time.monotonic() + 300
+    while min(len(nd.ordered_digests) for nd in pool.nodes) \
+            < warm and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert min(len(nd.ordered_digests) for nd in pool.nodes) >= warm, \
+        "overload warm-up stalled"
+    for i in range(64):
+        reads.submit(i)
+    reads.drain()
+    reads.served_total = reads.verified_total = 0
+    reads.serve_wall_s = 0.0
+
+    def min_ordered():
+        return min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    seq = [0]
+
+    def on_write(client, key):
+        seq[0] += 1
+        pool.submit_request(seq[0], client_id="c%d" % client)
+
+    gen = WorkloadGenerator(WorkloadSpec(
+        n_clients=250_000, rate=base_rate, duration=duration,
+        read_fraction=0.25, n_keys=n_keys, seed=seed,
+        profile=WorkloadProfile(kind="flash", peak=peak,
+                                flash_at=flash_at,
+                                flash_duration=flash_dur)))
+    gen.start(pool.timer, on_write,
+              on_read=lambda client, key: reads.submit(key))
+
+    ordered0 = min_ordered()
+    sim_t0 = pool.timer.get_current_time()
+    wall_t0 = time.perf_counter()
+    samples = {}  # sim instant -> ordered count (rate windows below)
+    marks = (1.0, flash_at, flash_at + flash_dur, 6.5, duration)
+    elapsed = 0.0
+    deadline = time.monotonic() + 600
+    # run through the arrival window, then settle until the queue AND
+    # the retry storm drain (outstanding re-offers included)
+    while (elapsed < duration + 8.0 or pool.admission.depth
+           or (pool.retry is not None and pool.retry.outstanding)) \
+            and time.monotonic() < deadline:
+        pool.run_for(0.5)
+        elapsed += 0.5
+        reads.drain()
+        for m in marks:
+            if m <= elapsed and m not in samples:
+                samples[m] = min_ordered()
+    wall_s = time.perf_counter() - wall_t0
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
+    assert pool.honest_nodes_agree()
+    ordered = min_ordered() - ordered0
+
+    adm = pool.admission
+    # a wall-deadline exit can leave late marks unsampled — fill them
+    # with the final count so the record degrades to skewed rates (the
+    # gate's floors then fail loudly) instead of a KeyError
+    for m in marks:
+        samples.setdefault(m, min_ordered())
+    # rate windows: pre-spike [1, flash_at]; post-spike [6.5, duration]
+    # (base arrivals still flowing, spike backlog drained) — recovery is
+    # post/pre, the no-metastable-collapse number
+    pre_rate = (samples[flash_at] - samples[1.0]) / (flash_at - 1.0)
+    post_rate = (samples[duration] - samples[6.5]) / (duration - 6.5)
+    retry_counters = pool.retry.counters() if pool.retry else None
+    readmitted = pool.metrics.stat(MetricsName.INGRESS_RETRY_ADMITTED)
+    readmitted_n = int(readmitted.total) if readmitted else 0
+    # normalize the warm-up wave out of the admission record (it was
+    # never part of the measured crowd — the overload gate's arm does
+    # the same subtraction)
+    adm_counters = adm.counters()
+    adm_counters["offered"] -= warm
+    adm_counters["admitted"] -= warm
+    return {
+        "retry": bool(retry),
+        "arrivals": gen.counters(),
+        "admission": adm_counters,
+        "shed_fraction": round(adm.shed_total
+                               / max(adm_counters["offered"], 1), 4),
+        "ordered": ordered,
+        "ordered_per_sim_second": round(ordered / sim_elapsed, 2),
+        "pre_spike_rate": round(pre_rate, 2),
+        "post_spike_rate": round(post_rate, 2),
+        "recovery_ratio": round(post_rate / pre_rate, 3)
+        if pre_rate else None,
+        # the goodput split: admissions that needed >= 1 retry vs
+        # first-attempt admissions (warm-up excluded on both sides)
+        "retry_admitted": readmitted_n,
+        "first_attempt_admitted": adm_counters["admitted"] - readmitted_n,
+        "retries": retry_counters,
+        "retry_hash": pool.retry.retry_hash() if pool.retry else None,
+        "shed_hash": adm.shed_hash(),
+        "ordered_hash": pool.ordered_hash(),
+        "read_proofs_per_sec": reads.counters()["read_qps"],
+        "reads_verified": reads.verified_total,
+        "governor": (pool.governor.trajectory_summary()
+                     if pool.governor is not None else None),
+        "sim_elapsed_s": round(sim_elapsed, 2),
+        "wall_s": round(wall_s, 2),
+    }
+
+
 def bench_saturation() -> dict:
     """Ingress-plane saturation (README "Ingress plane"): the seeded
     open-loop population drives n=16 BEYOND its service rate through the
     bounded admission queue, while the device-proof read path serves the
     read mix outside the 3PC plane. Run twice on the same seed — reads
     served vs reads dropped — to PROVE reads are free: identical
-    ordered_hash, identical vote-plane dispatch count."""
+    ordered_hash, identical vote-plane dispatch count.
+
+    The flash-crowd block (overload robustness plane) adds the
+    closed-loop arms: the same seeded crowd spike run open-loop (shed
+    requests walk away) vs with per-client seeded-backoff retries (shed
+    requests come BACK — how real overload compounds), measuring goodput
+    under the storm, the first-attempt/retry admission split, and the
+    post-spike recovery rate that proves no metastable collapse."""
     with_reads = _run_saturation(serve_reads=True)
     no_reads = _run_saturation(serve_reads=False)
     assert with_reads["ordered_hash"] == no_reads["ordered_hash"], \
@@ -1167,6 +1329,8 @@ def bench_saturation() -> dict:
         "serving reads changed the vote-plane dispatch count"
     assert with_reads["shed_hash"] == no_reads["shed_hash"], \
         "serving reads changed the shed set"
+    flash_open = _run_overload(retry=False)
+    flash_retry = _run_overload(retry=True)
     value = with_reads["ordered"] / with_reads["wall_s"] \
         if with_reads["wall_s"] else 0.0
     reads = with_reads["reads"]
@@ -1212,6 +1376,21 @@ def bench_saturation() -> dict:
         "ordered_hash_matches_no_reads": True,  # asserted above
         "shed_hash": with_reads["shed_hash"],
         "ordered_hash": with_reads["ordered_hash"],
+        # overload robustness plane: the closed-loop retry storm vs the
+        # open-loop crowd on the same seeded flash spike — goodput under
+        # the storm, the first-attempt/retry admission split, and the
+        # post-spike recovery proving no metastable collapse (the
+        # overload_gate re-measures these with hard floors and asserts
+        # byte-identical shed/retry/ordered replays)
+        "flash_crowd": {
+            "open_loop": flash_open,
+            "retry_storm": flash_retry,
+            "goodput_ratio": round(
+                flash_retry["ordered"] / flash_open["ordered"], 3)
+            if flash_open["ordered"] else None,
+            "retry_recovered_requests":
+                flash_retry["ordered"] - flash_open["ordered"],
+        },
     }
 
 

@@ -73,6 +73,14 @@ class ChaosReport:
     # ordered hashes — the cross-lane ordering record the cross_lane
     # invariant verified during the run
     lanes: Dict[str, Any] = field(default_factory=dict)
+    # overload robustness plane (workload-bearing scenarios): the
+    # admission/shed/retry record of the saturating open-loop load the
+    # scenario ran under — workload counters, admission counters, the
+    # shed_hash / retry_hash fingerprints (byte-identical per seed, so
+    # the overload gate replays them like trace_hash), and the
+    # per-seeder throttle meters proving the pool kept ordering while
+    # it seeded the returning victim
+    ingress: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> List[str]:

@@ -381,12 +381,51 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         overrides["QuorumTickInterval"] = quorum_tick_interval
         overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
     config = getConfig(overrides)
+    saturating = scenario.workload_rate > 0
+    if saturating and (quorum_tick_interval <= 0 or not device_quorum):
+        # the admission queue only drains on the dispatch tick: running
+        # a workload scenario per-message would fill the queue forever
+        # and 'pass' without ever exercising the overload plane
+        raise ValueError(
+            f"scenario {scenario.name!r} drives a saturating workload "
+            "and requires the tick-batched dispatch plane "
+            "(device_quorum=True, quorum_tick_interval > 0)")
     pool = SimPool(n_nodes=n, seed=seed, config=config,
                    device_quorum=device_quorum, mesh=mesh,
                    host_eval=host_eval, trace=trace,
                    real_execution=scenario.real_execution,
                    bls=scenario.bls,
+                   sign_requests=saturating,
                    num_instances=scenario.num_instances)
+    generator = None
+    if saturating:
+        # the overload plane: a seeded profiled open-loop population
+        # (flash crowds and all) submits through ADMISSION for the whole
+        # fault arc; with IngressRetryMax armed the pool's retry driver
+        # closes the loop on its sheds. Same seed as the fault plan, so
+        # the storm replays with the run.
+        from ..ingress.workload import (
+            WorkloadGenerator,
+            WorkloadProfile,
+            WorkloadSpec,
+        )
+
+        wl_seq = [0]
+
+        def _wl_write(client: int, key: int) -> None:
+            wl_seq[0] += 1
+            pool.submit_request(1_000_000 + wl_seq[0],
+                               client_id="c%d" % client)
+
+        generator = WorkloadGenerator(WorkloadSpec(
+            n_clients=scenario.workload_clients,
+            rate=scenario.workload_rate,
+            duration=scenario.workload_duration,
+            start=scenario.workload_start,
+            seed=seed,
+            profile=WorkloadProfile.from_config(
+                scenario.workload_profile, config)))
+        generator.start(pool.timer, _wl_write)
     checker = InvariantChecker(
         pool,
         byzantine=plan.byzantine_nodes,
@@ -424,8 +463,11 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                 pool.timer.schedule(fault.at + fault.duration,
                                     lambda v=fault.node: _snap_floor(v))
 
-    # run past the last bounded fault, then let the pool settle
-    horizon = max(scenario.run_seconds, plan.end_time + 5.0)
+    # run past the last bounded fault (and the workload window, for
+    # overload scenarios), then let the pool settle
+    horizon = max(scenario.run_seconds, plan.end_time + 5.0,
+                  scenario.workload_start + scenario.workload_duration
+                  + 5.0)
     pool.run_for(horizon)
     scheduler.stop_probe()
 
@@ -437,6 +479,36 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     metrics_summary = pool.metrics.summary()
     catchup_block = _catchup_block(pool, plan, scenario, leech_floor)
     results.extend(_catchup_verdicts(pool, plan, scenario, catchup_block))
+
+    # overload robustness plane: the saturation forensic record — the
+    # shed/retry fingerprints let the overload gate assert byte-
+    # identical replays, the seeder meters prove the throttle engaged
+    # while the pool kept ordering
+    ingress_block: Dict[str, object] = {}
+    if saturating and pool.admission is not None:
+        adm = pool.admission
+        ingress_block = {
+            "profile": scenario.workload_profile,
+            "workload": generator.counters(),
+            "admission": adm.counters(),
+            "shed_hash": adm.shed_hash(),
+        }
+        if pool.retry is not None:
+            ingress_block["retry"] = pool.retry.counters()
+            ingress_block["retry_hash"] = pool.retry.retry_hash()
+        seeders = {
+            nd.name: {"served_txns": nd.seeder.served_txns,
+                      "deferred": nd.seeder.deferred_total}
+            for nd in pool.nodes
+            if getattr(nd, "seeder", None) is not None}
+        if seeders:
+            ingress_block["seeder_throttle"] = {
+                "per_node": seeders,
+                "served_txns": sum(seeders[n]["served_txns"]
+                                   for n in sorted(seeders)),
+                "deferred": sum(seeders[n]["deferred"]
+                                for n in sorted(seeders)),
+            }
 
     report = ChaosReport(
         scenario=scenario.name,
@@ -470,6 +542,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             nd.name: nd.monitor.snapshot() for nd in pool.nodes
             if getattr(nd, "monitor", None) is not None},
         catchup=catchup_block,
+        ingress=ingress_block,
         byzantine_nodes=sorted(plan.byzantine_nodes),
         periodic_checks=len(scheduler.probe_results),
         first_violation=scheduler.first_violation,

@@ -79,6 +79,18 @@ class Scenario:
     # (barrier seal/skew/fingerprint) probes continuously, and liveness
     # probes every lane
     lanes: int = 0
+    # overload robustness plane: workload_rate > 0 drives a seeded
+    # open-loop population (profiled via workload_profile, closed-loop
+    # retries when the config overrides arm IngressRetryMax) through the
+    # pool's ADMISSION path for the scenario's whole fault arc. Requires
+    # the tick-batched dispatch plane (the ingress drain rides the tick)
+    # and sign_requests (the runner arms both); IngressQueueCapacity
+    # must come from config_overrides or nothing ever sheds.
+    workload_rate: float = 0.0
+    workload_duration: float = 0.0
+    workload_start: float = 0.0
+    workload_profile: str = "steady"
+    workload_clients: int = 10_000
 
     def plan(self, seed: int, n_nodes: int = 0) -> FaultPlan:
         n = n_nodes or self.n_nodes
@@ -458,6 +470,71 @@ register(Scenario(
         # is partitioned — give the stall watchdog room so they don't
         # churn instance changes against a wait that is by design
         "OrderingStallTimeout": 10.0,
+    }))
+
+
+# --- overload robustness: catchup while ingress saturates ----------------
+#
+# The catchup scenarios above recover on an otherwise-idle pool; real
+# recoveries happen while the pool is busiest. Here the GC-crossing
+# crash/restart arc runs UNDER a flash-crowd workload with closed-loop
+# retries: the victim restarts right as the crowd spikes, so the pool is
+# simultaneously (a) shedding + absorbing the retry storm, (b) ordering
+# the admitted backlog, and (c) seeding the victim's leecher — with the
+# seeder token bucket throttling (c) so it cannot stall (b). Verdicts
+# assert recovery (catchup_recovery) and the shed/retry fingerprints in
+# the report let the overload gate assert byte-identical replays.
+
+def _f_crash_catchup_under_saturation(rng: random.Random,
+                                      validators: List[str]) -> List:
+    _, crash = _crash_across_gc(rng, validators, at=2.0, duration=8.0)
+    return [crash]
+
+
+register(Scenario(
+    name="f_crash_catchup_under_saturation",
+    build=_f_crash_catchup_under_saturation,
+    description="GC-crossing crash/restart while a flash-crowd profile "
+                "saturates ingress and shed clients retry on seeded "
+                "backoff: the victim leeches back through a throttled "
+                "seeder (deferrals metered, ordering never stalls) and "
+                "the shed/retry sets replay byte-identically",
+    run_seconds=30.0,
+    liveness_timeout=60.0,
+    real_execution=True,
+    require_catchup=True,
+    # the crowd: a modest base rate whose flash spike (12x for 2s,
+    # absolute t=9.5..11.5) lands exactly as the victim restarts (t=10)
+    # and starts leeching
+    workload_rate=15.0,
+    workload_duration=6.0,
+    workload_start=6.0,
+    workload_profile="flash",
+    config_overrides={
+        **_CATCHUP_CONFIG,
+        # checkpoints still move fast (CHK_FREQ=2 in pp_seq space, the
+        # trickle keeps single-request batches flowing through the
+        # crash) but the crowd's admitted flood orders in REAL batches,
+        # and the victim leeches it back in REAL slices — at the catchup
+        # library's Max3PCBatchSize=1 / CatchupBatchSize=2 the backlog
+        # and the slice chatter alone would dominate the wall clock
+        "Max3PCBatchSize": 12,
+        "CatchupBatchSize": 10,
+        # admission + closed-loop retry: small queue so the spike sheds,
+        # snappy seeded backoff so retries land inside the run window
+        "IngressQueueCapacity": 6,
+        "IngressRetryMax": 3,
+        "IngressRetryBase": 0.3,
+        "IngressRetryBackoffMult": 2.0,
+        "IngressRetryBackoffMax": 4.0,
+        "WorkloadProfilePeak": 12.0,
+        "WorkloadProfileFlashAt": 3.5,
+        "WorkloadProfileFlashDuration": 2.0,
+        # seeder throttle: slices cost up to 10 txns (CatchupBatchSize),
+        # the 10-token bucket refills at 40 txns/s — back-to-back slices
+        # defer (metered) while the leecher's retry law rides the delay
+        "CatchupSeederThrottleTxnsPerSec": 40.0,
+        "CatchupSeederThrottleBurst": 10,
     }))
 
 

@@ -31,6 +31,13 @@ class MetricsName:
     INGRESS_QUEUE_DEPTH = "ingress.queue_depth"
     INGRESS_ADMITTED = "ingress.admitted"
     INGRESS_SHED = "ingress.shed"
+    # closed-loop retry (ingress/retry.py): seeded-backoff re-offers the
+    # retry driver actually fired, requests whose retry budget ran out
+    # (fail closed), and admitted requests that needed >= 1 retry — the
+    # goodput split: admitted - retry_admitted is first-attempt goodput
+    INGRESS_RETRIES = "ingress.retries"
+    INGRESS_RETRY_EXHAUSTED = "ingress.retry_exhausted"
+    INGRESS_RETRY_ADMITTED = "ingress.retry_admitted"
     READ_BATCH_SIZE = "ingress.read_batch_size"
     READ_SERVED = "ingress.read_served"
     READ_QPS = "ingress.read_qps"
@@ -105,6 +112,12 @@ class MetricsName:
     CATCHUP_PROOFS_VERIFIED = "catchup.proofs_verified"
     CATCHUP_REPS_REJECTED = "catchup.reps_rejected"
     CATCHUP_RETRIES = "catchup.retries"
+    # seeder-side throttle (server/catchup/seeder_service.py): txns this
+    # node served to leechers, and CATCHUP_REQ slices it deferred to a
+    # later virtual instant because the token bucket was dry — seeding a
+    # returning node must not stall the seeder's own ordering
+    CATCHUP_SEEDER_TXNS = "catchup.seeder_txns"
+    CATCHUP_SEEDER_DEFERRED = "catchup.seeder_deferred"
     # ordering lanes (keyspace-partitioned write path, lanes/): lane
     # count (Stat.last), per-lane ordered totals and router assignments
     # ("<prefix>.<lane>"), the barrier's sealed-window ordinal, and the

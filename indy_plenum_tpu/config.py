@@ -81,6 +81,15 @@ class Config:
     # backoff between these bounds
     CatchupFailedRetryBackoff: float = 10.0
     CatchupFailedRetryBackoffMax: float = 300.0
+    # Seeder-side throttle (server/catchup/seeder_service.py): a token
+    # bucket (txns/sec refill on the node's clock, Burst capacity) caps
+    # how fast a seeder answers CATCHUP_REQs — a pool seeding a
+    # returning node under ingress saturation must not stall its own
+    # ordering to feed the leecher. A dry bucket DEFERS the reply to the
+    # deterministic instant the tokens accrue (never drops it); the
+    # leecher's retry law tolerates the delay. 0 = unthrottled.
+    CatchupSeederThrottleTxnsPerSec: float = 0.0
+    CatchupSeederThrottleBurst: int = 200
 
     # --- propagation ------------------------------------------------------
     PropagateBatchWait: float = 0.1
@@ -143,6 +152,33 @@ class Config:
     # read flood cannot starve the drain. 0 = unbounded (pre-proof-plane
     # behaviour). The shed tiebreak shares IngressShedSeed.
     IngressReadQueueCapacity: int = 0
+
+    # --- closed-loop retry (ingress/retry.py) -----------------------------
+    # Per-client retry of shed/NACKed requests: the overload-robustness
+    # plane's client model. A shed request re-offers after a seeded
+    # exponential backoff (base * mult^(attempt-1), capped, stretched by
+    # sha256(seed|digest|attempt) jitter) up to IngressRetryMax attempts,
+    # then the client gives up (counted under ingress.retry_exhausted).
+    # 0 = open loop (the pre-overload-plane behaviour). Every re-offer
+    # re-enters admission: it counts against the fairness cap and
+    # competes in the same-instant shed cohort — no retry side door.
+    IngressRetryMax: int = 0
+    IngressRetryBase: float = 0.25
+    IngressRetryBackoffMult: float = 2.0
+    IngressRetryBackoffMax: float = 30.0
+    IngressRetryJitterFrac: float = 0.5
+
+    # --- workload profiles (ingress/workload.py) --------------------------
+    # Rate modulation for the open-loop generator: the diurnal curve's
+    # period and trough/peak multipliers, and the flash crowd's spike
+    # window (offset into the arrival window + duration) and peak
+    # multiplier (shared with diurnal's crest). Pure functions of
+    # virtual time — profiled runs replay byte-identically.
+    WorkloadProfilePeriod: float = 20.0
+    WorkloadProfileTrough: float = 0.5
+    WorkloadProfilePeak: float = 3.0
+    WorkloadProfileFlashAt: float = 0.0
+    WorkloadProfileFlashDuration: float = 2.0
 
     # --- ordering lanes (lanes/) ------------------------------------------
     # Keyspace-partitioned write path: the request keyspace splits across

@@ -9,6 +9,9 @@ Three cooperating parts (README "Ingress plane"):
   policy (drop-newest, seeded tiebreak, per-client fairness caps) and
   the :class:`~indy_plenum_tpu.ingress.admission.BackpressureSignal`
   that closes the loop into the dispatch governor;
+- :mod:`.retry` — the CLIENTS' side of overload: seeded-backoff
+  closed-loop retries of shed/NACKed requests (README "Overload
+  robustness") with the ``retry_hash`` fingerprint;
 - :mod:`.read_service` — GET-style state reads answered from a ledger's
   Merkle tree with the device audit-proof kernel, zero 3PC involvement.
 """
@@ -19,7 +22,8 @@ from .read_service import (
     ReadService,
     StaticCorpusBacking,
 )
-from .workload import WorkloadGenerator, WorkloadSpec
+from .retry import RetryDriver, RetryPolicy
+from .workload import WorkloadGenerator, WorkloadProfile, WorkloadSpec
 
 __all__ = [
     "AdmissionController",
@@ -27,7 +31,10 @@ __all__ = [
     "LedgerBacking",
     "ProofRead",
     "ReadService",
+    "RetryDriver",
+    "RetryPolicy",
     "StaticCorpusBacking",
     "WorkloadGenerator",
+    "WorkloadProfile",
     "WorkloadSpec",
 ]

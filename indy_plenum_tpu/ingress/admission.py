@@ -48,18 +48,26 @@ class BackpressureSignal:
     ``queue_depth`` is the PRE-drain depth (what accumulated over the
     tick interval), ``shed_delta`` the sheds since the previous tick,
     ``leeching`` whether any pool node is catching up (not
-    participating). A zero signal (0, 0, 0, False) is the explicit
-    no-pressure statement — the governor's law is bit-identical to the
-    PR 3/PR 4 occupancy-only law under it.
+    participating), ``retry_pressure`` how many closed-loop retries are
+    outstanding on the virtual timer (ingress/retry.py) — load the pool
+    ALREADY owes itself, which must hold the governor's narrow even
+    while the queue momentarily looks calm. A zero signal
+    (0, 0, 0, False, 0) is the explicit no-pressure statement — the
+    governor's law is bit-identical to the PR 3/PR 4 occupancy-only law
+    under it.
     """
 
     queue_depth: int = 0
     capacity: int = 0
     shed_delta: int = 0
     leeching: bool = False
+    retry_pressure: int = 0
 
     @property
     def queue_frac(self) -> float:
+        # capacity == 0 is the ingress-off (or synthetic-signal) case:
+        # no queue to fill means no fractional pressure, never a
+        # ZeroDivisionError
         return self.queue_depth / self.capacity if self.capacity else 0.0
 
 
@@ -85,8 +93,12 @@ class AdmissionController:
         # tail cohort (same ts) is the only eviction domain
         self._queue: List[Tuple[float, int, Optional[str], Any]] = []
         self._per_client: Dict[Optional[str], int] = {}
-        # sheds since the last drain: (req, reason); recorded by drain
-        self._shed_pending: List[Tuple[Any, str]] = []
+        # sheds since the last drain: (req, client_id, reason); recorded
+        # by drain — the client id rides along so the closed-loop retry
+        # driver can re-offer under the SAME identity (a retry that
+        # dodged the fairness cap by dropping its client would be cap
+        # evasion)
+        self._shed_pending: List[Tuple[Any, Optional[str], str]] = []
         self.offered_total = 0
         self.admitted_total = 0
         self.shed_total = 0
@@ -119,7 +131,7 @@ class AdmissionController:
               reason: str) -> None:
         self.shed_total += 1
         self.shed_digests.append(req.digest)
-        self._shed_pending.append((req, reason))
+        self._shed_pending.append((req, client_id, reason))
 
     def offer(self, req: Any, client_id: Optional[str] = None) -> bool:
         """Admit ``req`` into the bounded queue or shed it. Returns
@@ -162,10 +174,13 @@ class AdmissionController:
             self._per_client.get(client_id, 0) + 1
         return True
 
-    def drain(self) -> Tuple[List[Any], List[Tuple[Any, str]]]:
+    def drain(self) -> Tuple[List[Any],
+                             List[Tuple[Any, Optional[str], str]]]:
         """The tick's handoff: (admitted batch in arrival order, sheds
-        since the last drain with reasons). Callers record the sheds
-        under ``req.shed`` / ``ingress.shed`` — never ``AUTH_BATCH_*``."""
+        since the last drain as (req, client_id, reason)). Callers
+        record the sheds under ``req.shed`` / ``ingress.shed`` — never
+        ``AUTH_BATCH_*`` — and hand them to the retry driver when the
+        closed loop is armed."""
         batch = [req for (_ts, _r, _cid, req) in self._queue]
         self._queue.clear()
         self._per_client.clear()

@@ -119,6 +119,10 @@ class _Extract:
     def __init__(self, events: List[Dict[str, Any]]):
         self.req: Dict[str, Dict[str, float]] = {}   # digest -> marks
         self.rid_of: Dict[str, str] = {}             # digest -> ident|reqId
+        # closed-loop retry (overload robustness plane): re-offer count
+        # per digest — the retry hop spans from the first shed to the
+        # eventual admission (``marks`` carries both instants)
+        self.retry_count: Dict[str, int] = {}
         # ordering lanes: every mark a laned pool records carries
         # args["lane"] (LaneTraceView), and the cross-lane barrier
         # stamps barrier.ready/barrier.sealed marks (cat "lanes") —
@@ -164,6 +168,9 @@ class _Extract:
             _earliest(marks, name, ts)
             if name == "req.ingress" and args.get("rid"):
                 self.rid_of[key[0]] = args["rid"]
+            if name == "req.retry":
+                self.retry_count[key[0]] = \
+                    self.retry_count.get(key[0], 0) + 1
             if "lane" in args and key[0] not in self.req_lane:
                 self.req_lane[key[0]] = args["lane"]
         elif cat == "3pc" and key and len(key) >= 3 \
@@ -278,12 +285,16 @@ class _Extract:
 # lands in; the ``order`` hop is the dispatch-tick / in-order wait and
 # charges to ``device`` when the dump shows a tick-batched plane. The
 # ``barrier`` hop (ordering lanes: executed -> the cross-lane seal of
-# the batch's checkpoint window) exists only in laned dumps and — like
-# ``admission`` — is skipped, not counted incomplete, when absent.
-_HOPS = ("admission", "auth", "batching", "preprepare", "prepare",
-         "commit", "order", "execute", "barrier")
-_OPTIONAL_HOPS = ("admission", "barrier")
-_RESIDUAL_OF = {"admission": "queue", "auth": "compute",
+# the batch's checkpoint window) exists only in laned dumps, and the
+# ``retry`` hop (overload robustness plane: first shed -> the eventual
+# admission of the backoff chain) only for requests the closed loop
+# actually retried — both, like ``admission``, are skipped rather than
+# counted incomplete when absent.
+_HOPS = ("admission", "retry", "auth", "batching", "preprepare",
+         "prepare", "commit", "order", "execute", "barrier")
+_OPTIONAL_HOPS = ("admission", "retry", "barrier")
+_RESIDUAL_OF = {"admission": "queue", "retry": "queue",
+                "auth": "compute",
                 "batching": "queue", "preprepare": "queue",
                 "prepare": "queue", "commit": "queue",
                 "order": "queue", "execute": "compute",
@@ -344,11 +355,22 @@ def _build_journeys(events: List[Dict[str, Any]]
             t_ing = rmarks.get("req.ingress")
             t_adm = rmarks.get("req.admitted")
             t_fin = rmarks.get("req.finalised")
+            # closed-loop retry: a retried-then-ordered request's wait
+            # splits at its FIRST shed — admission covers the first
+            # attempt, the retry hop the whole backoff chain through to
+            # the eventual admission (contiguous, so attribution never
+            # double-counts); unretried requests keep the exact
+            # pre-overload-plane chain
+            t_shed1 = rmarks.get("req.shed")
+            retried = digest in x.retry_count \
+                and t_shed1 is not None and t_adm is not None
             # hop chain: each entry (t0, t1); None timestamps leave the
             # hop out (and mark the journey incomplete below)
             chain = {
-                "admission": (t_ing, t_adm) if t_adm is not None
-                else None,
+                "admission": ((t_ing, t_shed1) if retried
+                              else (t_ing, t_adm) if t_adm is not None
+                              else None),
+                "retry": (t_shed1, t_adm) if retried else None,
                 "auth": (t_adm if t_adm is not None else t_ing, t_fin),
                 "batching": (t_fin, t_sent),
                 "preprepare": (t_sent, t_pp),
@@ -403,6 +425,11 @@ def _build_journeys(events: List[Dict[str, Any]]
                 # ordering lanes: which lane ordered it (absent in
                 # single-lane dumps — existing tables stay byte-stable)
                 **({"lane": lane} if lane is not None else {}),
+                # closed-loop retry: how many re-offers it took (absent
+                # for first-attempt requests — retry-free tables stay
+                # byte-stable)
+                **({"retries": x.retry_count[digest]}
+                   if digest in x.retry_count else {}),
                 "t_ingress": _r(t_ing),
                 "e2e": _r(t_exe - t_ing) if complete else None,
                 "hops": hops,
@@ -416,9 +443,25 @@ def _build_journeys(events: List[Dict[str, Any]]
             journeys.append(journey)
     journeys.sort(key=lambda j: (j["t_ingress"] is None,
                                  j["t_ingress"] or 0.0, j["digest"]))
-    shed = sorted(d for d, m in x.req.items() if "req.shed" in m)
-    pending = sorted(d for d, m in x.req.items()
-                     if d not in ordered_digests and "req.shed" not in m)
+    # a retried request is a journey (ordered) or still PENDING (its
+    # backoff chain alive at dump time), never a shed: ``shed`` means
+    # TERMINALLY shed — the closed loop gave up (req.retry_exhausted) or
+    # never ran. Whether the loop ran is a DUMP-level fact (a shed whose
+    # first re-offer is still on the timer has no per-request retry mark
+    # yet), so any retry activity anywhere in the dump marks the loop
+    # armed and unexhausted sheds count as pending. Retry-free dumps are
+    # exactly the old "has a req.shed mark" set.
+    loop_armed = bool(x.retry_count) or any(
+        "req.retry_exhausted" in m for m in x.req.values())
+    shed = sorted(
+        d for d, m in x.req.items()
+        if "req.shed" in m and d not in ordered_digests
+        and ("req.retry_exhausted" in m or not loop_armed))
+    pending = sorted(
+        d for d, m in x.req.items()
+        if d not in ordered_digests
+        and ("req.shed" not in m
+             or (loop_armed and "req.retry_exhausted" not in m)))
     built = {"journeys": journeys, "pending": pending, "shed": shed,
              "read_e2e": x.read_e2e,
              "fault_windows": [[_r(a), _r(b)]
@@ -480,6 +523,9 @@ def journey_summary(events: List[Dict[str, Any]],
         "pending": len(built["pending"]),
         "shed": len(built["shed"]),
         "catchup_journeys": sum(1 for j in journeys if j.get("catchup")),
+        # closed-loop retry: journeys that got in only after >= 1
+        # seeded-backoff re-offer (their tables carry the retry hop)
+        "retried": sum(1 for j in journeys if j.get("retries")),
         "journey_hash": journey_hash(journeys),
         "e2e": {"write": _pct_block(e2e),
                 "read": _pct_block(built["read_e2e"])},

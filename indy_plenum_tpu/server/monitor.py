@@ -218,10 +218,26 @@ class Monitor:
             for label, name in (
                     ("admitted", MetricsName.INGRESS_ADMITTED),
                     ("shed", MetricsName.INGRESS_SHED),
+                    ("retries", MetricsName.INGRESS_RETRIES),
+                    ("retry_exhausted",
+                     MetricsName.INGRESS_RETRY_EXHAUSTED),
                     ("read_served", MetricsName.READ_SERVED)):
                 stat = self._metrics.stat(name)
                 if stat is not None:
                     ingress[label] = int(stat.total)
+            # closed-loop retry goodput: the share of admitted work that
+            # got in on its FIRST attempt (retry admissions are recovered
+            # capacity, not fresh goodput) — present only when the run
+            # recorded retries, so pre-overload-plane snapshots stay
+            # byte-compatible
+            if "retries" in ingress and ingress.get("admitted"):
+                readmitted = self._metrics.stat(
+                    MetricsName.INGRESS_RETRY_ADMITTED)
+                readmitted_n = int(readmitted.total) \
+                    if readmitted is not None else 0
+                ingress["goodput_fraction"] = round(
+                    (ingress["admitted"] - readmitted_n)
+                    / ingress["admitted"], 4)
             read_qps = self._metrics.stat(MetricsName.READ_QPS)
             if read_qps is not None:
                 ingress["read_qps"] = round(read_qps.last, 1)

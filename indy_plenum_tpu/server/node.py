@@ -349,7 +349,8 @@ class Node:
         from .catchup import NodeLeecherService, SeederService
 
         self.seeder = SeederService(
-            self.external_bus, self.boot.db, own_name=name)
+            self.external_bus, self.boot.db, own_name=name,
+            timer=timer, config=self.config, metrics=self.metrics)
 
         def catchup_suspicion(ex):
             self.internal_bus.send(RaisedSuspicion(inst_id=0, ex=ex))
@@ -748,7 +749,7 @@ class Node:
             if shed:
                 self.metrics.add_event(MetricsName.INGRESS_SHED,
                                        len(shed))
-                for req, reason in shed:
+                for req, _cid, reason in shed:
                     if self.trace.enabled:
                         self.trace.record("req.shed", cat="req",
                                           node=self.name,

@@ -293,7 +293,8 @@ class SimNode:
             from ..server.catchup import NodeLeecherService, SeederService
 
             self.seeder = SeederService(
-                self.external_bus, self.boot.db, own_name=name)
+                self.external_bus, self.boot.db, own_name=name,
+                timer=timer, config=config, metrics=metrics)
 
             def catchup_suspicion(ex):
                 from ..common.messages.internal_messages import (
@@ -479,6 +480,20 @@ class SimPool:
                 capacity=self.config.IngressQueueCapacity,
                 per_client_cap=self.config.IngressPerClientCap,
                 seed=seed, clock=self.timer.get_current_time)
+        # closed-loop retry (overload robustness plane): shed requests
+        # come BACK on a seeded backoff — the drain hands each tick's
+        # sheds to the driver, the driver re-offers them through the
+        # same admission path (fairness cap and shed cohort included).
+        # Seeded with the POOL seed like the shed tiebreak, so the
+        # retry storm replays byte-identically (retry_hash).
+        self.retry = None
+        if self.admission is not None and self.config.IngressRetryMax > 0:
+            from ..ingress.retry import RetryDriver, RetryPolicy
+
+            self.retry = RetryDriver(
+                RetryPolicy.from_config(self.config, seed=seed),
+                self.timer, self._retry_offer,
+                metrics=self.metrics, trace=self.trace)
 
         self.bls_keys = None
         if bls:
@@ -697,6 +712,15 @@ class SimPool:
                                   key=(req.digest,))
         return req
 
+    def _retry_offer(self, req: Request,
+                     client_id: Optional[str] = None) -> None:
+        """The retry driver's re-offer seam: the SAME request (already
+        signed, ``req.ingress`` already marked at first arrival)
+        re-enters the bounded queue like any arrival — it competes in
+        the same-instant shed cohort and counts against its client's
+        fairness cap (no retry-based cap evasion)."""
+        self.admission.offer(req, client_id)
+
     def submit_tampered_request(self, seq: int) -> Request:
         """Signed, then payload mutated: the device verify must reject it."""
         assert self.sign_requests
@@ -734,14 +758,28 @@ class SimPool:
                 for req in batch:
                     self.trace.record("req.admitted", cat="req",
                                       key=(req.digest,))
+            if self.retry is not None and batch:
+                # the goodput split: admitted work that needed >= 1
+                # retry vs first-attempt admissions
+                readmitted = sum(
+                    1 for req in batch
+                    if req.digest in self.retry.retried_digests)
+                if readmitted:
+                    self.metrics.add_event(
+                        MetricsName.INGRESS_RETRY_ADMITTED, readmitted)
             if shed:
                 self.metrics.add_event(MetricsName.INGRESS_SHED,
                                        len(shed))
                 if trace_on:
-                    for req, reason in shed:
+                    for req, _cid, reason in shed:
                         self.trace.record("req.shed", cat="req",
                                           key=(req.digest,),
                                           args={"reason": reason})
+                if self.retry is not None:
+                    # the closed loop: this tick's sheds schedule their
+                    # seeded-backoff re-offers on the virtual timer
+                    for req, cid, reason in shed:
+                        self.retry.on_shed(req, cid, reason)
         else:
             batch, self._ingress = self._ingress, []
         if not batch:
@@ -779,7 +817,11 @@ class SimPool:
             capacity=self.admission.capacity,
             shed_delta=self._last_ingress_shed,
             leeching=any(not nd.data.is_participating
-                         for nd in self.nodes))
+                         for nd in self.nodes),
+            # re-offers still waiting on the timer: load the pool owes
+            # itself — holds the governor's narrow between shed bursts
+            retry_pressure=(self.retry.outstanding
+                            if self.retry is not None else 0))
 
     def make_read_service(self, name: str = "node0", mode: str = "host",
                           capacity: int = 0):

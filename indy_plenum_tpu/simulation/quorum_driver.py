@@ -268,14 +268,16 @@ def drive_lane_ticks(timer: TimerService, config: Config, lane_pools,
         if governor is not None:
             if signals:
                 # fold per-lane pressure: the most-pressured lane's
-                # queue fraction drives the narrow decision, sheds sum,
-                # and any lane leeching widens
+                # queue fraction drives the narrow decision, sheds and
+                # outstanding retries sum, and any lane leeching widens
                 worst = max(signals, key=lambda s: s.queue_frac)
                 governor.feed_backpressure(BackpressureSignal(
                     queue_depth=worst.queue_depth,
                     capacity=worst.capacity,
                     shed_delta=sum(s.shed_delta for s in signals),
-                    leeching=any(s.leeching for s in signals)))
+                    leeching=any(s.leeching for s in signals),
+                    retry_pressure=sum(s.retry_pressure
+                                       for s in signals)))
             new_interval = governor.observe_shards(
                 vote_deltas, cap_deltas, dispatches,
                 inflight=any(g.lagging for g in tick_groups))

@@ -101,6 +101,7 @@ class DispatchGovernor:
         self._backpressure = None
         self.backpressure_narrows = 0
         self.backpressure_widens = 0
+        self.backpressure_holds = 0
         self.ewma: Optional[float] = None  # occupancy EWMA (None = cold)
         # per-shard EWMAs (mesh-sharded dispatch plane): one series per
         # shard, all fed the same law; ``ewma`` above is always the
@@ -173,24 +174,41 @@ class DispatchGovernor:
                 self.alpha * occ + (1.0 - self.alpha) * ewma
                 for occ, ewma in zip(occs, self.shard_ewmas)]
         self.ewma = max(self.shard_ewmas)
+        # the signal is popped BEFORE the base law so retry pressure can
+        # gate the occupancy widen (below); None / zero-signal paths
+        # leave every branch bit-identical to the PR 3/PR 4 law
+        sig, self._backpressure = self._backpressure, None
+        retry_hold = sig is not None and sig.retry_pressure > 0
         saturated = dispatches > 1 or self.ewma >= self.occupancy_high
         if saturated:
             self.interval = max(self.interval * self.narrow,
                                 self.min_interval)
         elif self.ewma <= self.occupancy_low:
-            self.interval = min(self.interval * self.widen,
-                                self.max_interval)
+            # retry-pressure HOLD (overload robustness plane): between
+            # shed bursts a retry storm looks calm — the queue drained,
+            # occupancy dipped — but the re-offers already sit on the
+            # timer. Widening here is the metastable oscillation: wide
+            # tick -> the whole backoff cohort lands in one drain ->
+            # shed -> narrow -> repeat. While retries are outstanding
+            # the law holds its narrow instead of widening; the widen
+            # resumes the first tick the storm is actually over.
+            if retry_hold:
+                self.backpressure_holds += 1
+            else:
+                self.interval = min(self.interval * self.widen,
+                                    self.max_interval)
         # ingress backpressure (PR 3's open "widen while leeching" hook):
         # queue growth or shedding narrows ON TOP of the occupancy law —
         # draining the auth queue sooner is the only relief the tick can
         # offer — while a leeching pool widens: a node replaying ledger
         # catchup gains nothing from tight ticks, and wider ticks hand
         # the host loop to the leecher. Queue growth outranks leeching
-        # (a full queue hurts now; catchup tolerates latency). Narrowing
+        # (a full queue hurts now; catchup tolerates latency), and
+        # leeching outranks the retry hold (seeder throttling protects
+        # ordering; the leecher still gets its wide ticks). Narrowing
         # here counts as saturation for the anomaly trigger: pinned at
         # the floor with the queue still growing is exactly the moment a
         # trace tail is worth keeping.
-        sig, self._backpressure = self._backpressure, None
         if sig is not None:
             growth = sig.shed_delta > 0 or (
                 sig.capacity > 0 and sig.queue_depth
@@ -279,9 +297,11 @@ class DispatchGovernor:
                                if self.ewma is not None else None),
             "anomalies": self.anomalies,
         }
-        if self.backpressure_narrows or self.backpressure_widens:
+        if self.backpressure_narrows or self.backpressure_widens \
+                or self.backpressure_holds:
             out["backpressure_narrows"] = self.backpressure_narrows
             out["backpressure_widens"] = self.backpressure_widens
+            out["backpressure_holds"] = self.backpressure_holds
         if self.shard_ewmas is not None and len(self.shard_ewmas) > 1:
             out["shards"] = len(self.shard_ewmas)
             out["shard_occupancy_ewma"] = [

@@ -636,6 +636,224 @@ def ingress_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def _measure_overload(args, retry: bool, seed: int) -> dict:
+    """One flash-crowd overload arm at the gate shape (n=6, bounded
+    queue, adaptive tick): a sub-saturation base rate with a hard crowd
+    spike, open-loop (``retry=False``, shed requests walk away) or
+    closed-loop (``retry=True``, every shed re-offers on the seeded
+    backoff). Returns the goodput / recovery / fingerprint record the
+    overload gate compares."""
+    from indy_plenum_tpu.common.metrics_collector import MetricsName
+    from indy_plenum_tpu.ingress import (
+        WorkloadGenerator,
+        WorkloadProfile,
+        WorkloadSpec,
+    )
+
+    n_nodes, capacity = 6, 10
+    base_rate, duration = 80.0, 7.0
+    flash_at, flash_dur, peak = 2.5, 1.25, 10.0
+    config = getConfig({
+        "Max3PCBatchSize": 40,
+        "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": 0.1,
+        "QuorumTickAdaptive": True,
+        "IngressQueueCapacity": capacity,
+        "IngressRetryMax": 4 if retry else 0,
+        "IngressRetryBase": 0.2,
+        "IngressRetryBackoffMult": 2.0,
+        "IngressRetryBackoffMax": 2.0,
+    })
+    pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   sign_requests=True)
+
+    def min_ordered():
+        return min(len(nd.ordered_digests) for nd in pool.nodes)
+
+    warm = capacity - 4
+    for i in range(warm):
+        pool.submit_request(10_000_000 + i, client_id="warm")
+    deadline = time.monotonic() + 300
+    while min_ordered() < warm and time.monotonic() < deadline:
+        pool.run_for(0.5)
+    assert min_ordered() >= warm, "overload-gate warm-up stalled"
+    ordered0 = min_ordered()
+
+    seq = [0]
+
+    def on_write(client: int, key: int) -> None:
+        seq[0] += 1
+        pool.submit_request(seq[0], client_id="c%d" % client)
+
+    gen = WorkloadGenerator(WorkloadSpec(
+        n_clients=100_000, rate=base_rate, duration=duration,
+        read_fraction=0.0, n_keys=64, seed=seed,
+        profile=WorkloadProfile(kind="flash", peak=peak,
+                                flash_at=flash_at,
+                                flash_duration=flash_dur)))
+    gen.start(pool.timer, on_write)
+
+    sim_t0 = pool.timer.get_current_time()
+    samples = {}
+    marks = (1.0, flash_at, flash_at + flash_dur, 5.0, duration)
+    elapsed = 0.0
+    deadline = time.monotonic() + 600
+    while (elapsed < duration + 6.0 or pool.admission.depth
+           or (pool.retry is not None and pool.retry.outstanding)) \
+            and time.monotonic() < deadline:
+        pool.run_for(0.5)
+        elapsed += 0.5
+        for m in marks:
+            if m <= elapsed and m not in samples:
+                samples[m] = min_ordered()
+    assert pool.honest_nodes_agree()
+    sim_elapsed = pool.timer.get_current_time() - sim_t0
+    adm = pool.admission
+    # a wall-deadline exit can leave late marks unsampled — fill them
+    # with the final count so the gate fails on its rate floors instead
+    # of a KeyError
+    for m in marks:
+        samples.setdefault(m, min_ordered())
+    pre_rate = (samples[flash_at] - samples[1.0]) / (flash_at - 1.0)
+    post_rate = (samples[duration] - samples[5.0]) / (duration - 5.0)
+    readmitted = pool.metrics.stat(MetricsName.INGRESS_RETRY_ADMITTED)
+    return {
+        "retry": bool(retry),
+        "arrivals": gen.arrivals,
+        "admitted": adm.admitted_total - warm,
+        "shed": adm.shed_total,
+        "ordered": min_ordered() - ordered0,
+        "ordered_per_sim_second": round(
+            (min_ordered() - ordered0) / sim_elapsed, 2)
+        if sim_elapsed else None,
+        "pre_spike_rate": round(pre_rate, 2),
+        "post_spike_rate": round(post_rate, 2),
+        "recovery_ratio": round(post_rate / pre_rate, 3)
+        if pre_rate else None,
+        "retry_admitted": int(readmitted.total) if readmitted else 0,
+        "reoffers": pool.retry.reoffers_total if pool.retry else 0,
+        "retry_exhausted": pool.retry.exhausted_total
+        if pool.retry else 0,
+        "shed_hash": adm.shed_hash(),
+        "retry_hash": pool.retry.retry_hash() if pool.retry else None,
+        "ordered_hash": pool.ordered_hash(),
+        "governor": (pool.governor.trajectory_summary()
+                     if pool.governor is not None else None),
+    }
+
+
+def overload_gate(args) -> "tuple[dict, list]":
+    """Overload robustness gate (ISSUE 15): the closed-loop retry storm
+    must degrade GRACEFULLY, never metastably. On the same seeded
+    flash-crowd spike:
+
+    1. the spike must actually overload (open arm sheds, retry arm
+       re-offers — a gate that never engages the storm is vacuous);
+    2. goodput under the retry storm must hold >=
+       ``--overload-goodput-floor`` of the open-loop arm (the storm
+       compounds offered load; it must not crush throughput);
+    3. ordered/sim-sec must RECOVER after the crowd ends — post-spike
+       rate within ``--overload-recovery-tolerance`` of pre-spike on
+       both arms (a metastable pool never comes back);
+    4. two same-seed retry runs must replay byte-identical
+       shed/retry/ordered fingerprints;
+    5. the ``f_crash_catchup_under_saturation`` chaos scenario (victim
+       crashes across GC'd windows while the crowd spikes and clients
+       retry) must PASS every verdict — catchup_recovery included —
+       with the seeder throttle's deferral meter engaged (the pool kept
+       ordering while it fed the leecher) and a byte-identical replay.
+    """
+    from indy_plenum_tpu.chaos import run_scenario
+
+    open_arm = _measure_overload(args, retry=False, seed=args.seed)
+    storm = _measure_overload(args, retry=True, seed=args.seed)
+    storm2 = _measure_overload(args, retry=True, seed=args.seed)
+
+    failures = []
+    if open_arm["shed"] == 0:
+        failures.append("open-loop arm shed nothing — the flash crowd "
+                        "never overloaded the queue (gate vacuous)")
+    if storm["reoffers"] == 0:
+        failures.append("retry arm re-offered nothing — the closed "
+                        "loop never engaged (gate vacuous)")
+    floor = args.overload_goodput_floor
+    ratio = storm["ordered"] / open_arm["ordered"] \
+        if open_arm["ordered"] else 0.0
+    if ratio < floor:
+        failures.append(
+            f"retry-storm goodput {storm['ordered']} fell to "
+            f"{ratio:.2f}x of the open-loop arm {open_arm['ordered']} "
+            f"(floor {floor})")
+    tol = args.overload_recovery_tolerance
+    for arm, rec in (("open", open_arm), ("retry", storm)):
+        if (rec["recovery_ratio"] or 0.0) < 1.0 - tol:
+            failures.append(
+                f"metastable collapse on the {arm} arm: post-spike "
+                f"rate {rec['post_spike_rate']} never recovered to "
+                f"pre-spike {rec['pre_spike_rate']} "
+                f"(ratio {rec['recovery_ratio']}, tolerance {tol})")
+    for key in ("shed_hash", "retry_hash", "ordered_hash"):
+        if storm2[key] != storm[key]:
+            failures.append(
+                f"retry storm is not deterministic: {key} diverged "
+                "across identical same-seed runs")
+
+    t0 = time.perf_counter()
+    chaos = run_scenario("f_crash_catchup_under_saturation",
+                         seed=args.seed, device_quorum=True,
+                         quorum_tick_interval=0.1,
+                         quorum_tick_adaptive=True, trace=True)
+    chaos_wall = time.perf_counter() - t0
+    replay = run_scenario("f_crash_catchup_under_saturation",
+                          seed=args.seed, device_quorum=True,
+                          quorum_tick_interval=0.1,
+                          quorum_tick_adaptive=True, trace=True)
+    if not chaos.verdict_as_expected:
+        failures.append(
+            f"f_crash_catchup_under_saturation verdicts: "
+            f"failed={chaos.failed}")
+    throttle = chaos.ingress.get("seeder_throttle", {})
+    if not throttle.get("deferred"):
+        failures.append("seeder throttle never deferred a slice — the "
+                        "ordering-protection meter never engaged")
+    if not (chaos.ingress.get("retry") or {}).get("reoffers"):
+        failures.append("chaos arc saw no closed-loop retries — the "
+                        "storm never reached the recovering pool")
+    if replay.trace_hash != chaos.trace_hash \
+            or replay.ingress.get("shed_hash") \
+            != chaos.ingress.get("shed_hash") \
+            or replay.ingress.get("retry_hash") \
+            != chaos.ingress.get("retry_hash"):
+        failures.append("catchup-under-saturation run does not replay "
+                        "byte-identically (trace/shed/retry hash)")
+
+    record = {
+        "open_loop": open_arm,
+        "retry_storm": storm,
+        "goodput_floor": floor,
+        "goodput_ratio": round(ratio, 3),
+        "recovery_tolerance": tol,
+        "deterministic": all(storm2[k] == storm[k] for k in
+                             ("shed_hash", "retry_hash",
+                              "ordered_hash")),
+        "chaos": {
+            "scenario": "f_crash_catchup_under_saturation",
+            "verdicts_pass": chaos.verdict_as_expected,
+            "catchup": {k: chaos.catchup.get(k)
+                        for k in ("rounds", "txns_leeched",
+                                  "proofs_verified")},
+            "admission": chaos.ingress.get("admission"),
+            "retry": chaos.ingress.get("retry"),
+            "seeder_throttle": throttle,
+            "replay_identical": replay.trace_hash == chaos.trace_hash,
+            "wall_s": round(chaos_wall, 2),
+            "replay_command": chaos.replay_command,
+        },
+    }
+    return record, failures
+
+
 def proof_gate(args) -> "tuple[dict, list]":
     """State-proof plane gate: (1) the SAME seeded real-execution BLS
     pool with and without proof-serving reads must order bit-identical
@@ -1102,6 +1320,10 @@ GATES = {
     "trace": ("no_trace_gate", "flight-recorder overhead + identity"),
     "readback": ("no_readback_gate", "device-eval vs host-eval readback"),
     "ingress": ("no_ingress_gate", "open-loop saturation/admission"),
+    "overload": ("no_overload_gate",
+                 "closed-loop retry storm: goodput floor, no metastable "
+                 "collapse, byte-identical replay, catchup under "
+                 "saturation with seeder throttling"),
     "proof": ("no_proof_gate", "state-proof plane (BLS, zero pairings)"),
     "catchup": ("no_catchup_gate", "chaos-hardened catchup recovery"),
     "lanes": ("no_lanes_gate",
@@ -1133,6 +1355,19 @@ def main() -> int:
                     help="skip the flight-recorder overhead comparison")
     ap.add_argument("--no-ingress-gate", action="store_true",
                     help="skip the open-loop saturation/admission gate")
+    ap.add_argument("--no-overload-gate", action="store_true",
+                    help="skip the overload robustness gate (flash-"
+                         "crowd retry storm goodput/recovery floors, "
+                         "byte-identical shed/retry/ordered replay, "
+                         "catchup-under-saturation chaos verdicts)")
+    ap.add_argument("--overload-goodput-floor", type=float, default=0.70,
+                    help="min retry-storm ordered count as a fraction "
+                         "of the open-loop arm's")
+    ap.add_argument("--overload-recovery-tolerance", type=float,
+                    default=0.30,
+                    help="max fractional post-spike ordered-rate "
+                         "shortfall vs pre-spike (metastable-collapse "
+                         "detector) either overload arm may show")
     ap.add_argument("--no-readback-gate", action="store_true",
                     help="skip the device-eval vs host-eval ordering "
                          "fast path comparison")
@@ -1306,6 +1541,10 @@ def main() -> int:
     if not args.no_ingress_gate:
         record, failures = ingress_gate(args)
         result["ingress_gate"] = record
+        over.extend(failures)
+    if not args.no_overload_gate:
+        record, failures = overload_gate(args)
+        result["overload_gate"] = record
         over.extend(failures)
     if not args.no_proof_gate:
         record, failures = proof_gate(args)

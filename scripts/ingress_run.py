@@ -10,9 +10,19 @@ read qps, ``ordered_hash`` and ``trace_hash``. Same seed => byte-identical
 record fields (the wall-clock ones excepted) — replay a saturation
 incident exactly.
 
+Workload profiles + closed-loop retry (overload robustness plane):
+``--profile diurnal|flash`` modulates the arrival rate (day curve /
+crowd spike — the ``WorkloadProfile*`` config knobs shape it), and
+``--retry`` arms the per-client seeded-backoff retry of shed requests
+(``--retry-max`` attempts). The JSON record then carries a ``retry``
+block: attempts, exhausted clients, the first-attempt/retry admission
+split, the goodput fraction, and the ``retry_hash`` fingerprint.
+
 Usage:
     python scripts/ingress_run.py --nodes 16 --rate 400 --duration 20 \
         --capacity 256 --read-fraction 0.5 --json
+    python scripts/ingress_run.py --profile flash --retry --retry-max 4 \
+        --rate 300 --capacity 64 --json
 """
 import argparse
 import json
@@ -39,6 +49,7 @@ from indy_plenum_tpu.ingress import (  # noqa: E402
     ReadService,
     StaticCorpusBacking,
     WorkloadGenerator,
+    WorkloadProfile,
     WorkloadSpec,
 )
 from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
@@ -52,6 +63,7 @@ def build_pool(args) -> SimPool:
         "QuorumTickAdaptive": not args.static_tick,
         "IngressQueueCapacity": args.capacity,
         "IngressPerClientCap": args.per_client_cap,
+        "IngressRetryMax": args.retry_max if args.retry else 0,
     })
     return SimPool(n_nodes=args.nodes, seed=args.seed, config=config,
                    device_quorum=True, shadow_check=False,
@@ -82,6 +94,19 @@ def main() -> int:
     ap.add_argument("--zipf-keys", type=float, default=1.2)
     ap.add_argument("--keys", type=int, default=16384,
                     help="hot-key universe (NYM/attrib read corpus)")
+    ap.add_argument("--profile", default="steady",
+                    choices=["steady", "diurnal", "flash"],
+                    help="arrival-rate modulation: steady (flat), "
+                         "diurnal (day curve), flash (crowd spike) — "
+                         "shaped by the WorkloadProfile* config knobs")
+    # closed-loop retry (overload robustness plane)
+    ap.add_argument("--retry", action="store_true",
+                    help="arm per-client seeded-backoff retries of shed "
+                         "requests (the closed loop real overload "
+                         "compounds through)")
+    ap.add_argument("--retry-max", type=int, default=3,
+                    help="retry budget per request before the client "
+                         "gives up (must be >= 1)")
     # admission
     ap.add_argument("--capacity", type=int, default=256,
                     help="bounded auth-queue capacity (per tick drain)")
@@ -97,6 +122,11 @@ def main() -> int:
         # fail here, not with an AttributeError after the full run
         ap.error("--capacity must be >= 1 (0 disables admission control, "
                  "which this driver exists to measure)")
+    if args.retry_max < 1:
+        # a zero/negative budget silently disarms the loop the flag
+        # asked for — refuse instead of reporting an empty retry block
+        ap.error("--retry-max must be >= 1 (a request needs at least "
+                 "one retry for the closed loop to exist)")
 
     pool = build_pool(args)
     reads = ReadService(StaticCorpusBacking(args.keys, seed=args.seed),
@@ -121,7 +151,8 @@ def main() -> int:
         n_clients=args.clients, rate=args.rate, duration=args.duration,
         read_fraction=args.read_fraction,
         zipf_clients=args.zipf_clients, zipf_keys=args.zipf_keys,
-        n_keys=args.keys, seed=args.seed))
+        n_keys=args.keys, seed=args.seed,
+        profile=WorkloadProfile.from_config(args.profile, pool.config)))
     gen.start(pool.timer, on_write,
               on_read=lambda client, key: reads.submit(key))
 
@@ -130,7 +161,10 @@ def main() -> int:
     horizon = args.duration + args.settle
     step = 0.5
     elapsed = 0.0
-    while elapsed < horizon:
+    # run the arrival window + settle, then keep going until the queue
+    # AND the retry storm drain (outstanding seeded re-offers included)
+    while elapsed < horizon or pool.admission.depth \
+            or (pool.retry is not None and pool.retry.outstanding):
         pool.run_for(step)
         elapsed += step
         reads.drain()  # reads ride the driver loop: zero 3PC involvement
@@ -148,6 +182,7 @@ def main() -> int:
         "nodes": args.nodes,
         "instances": args.instances,
         "seed": args.seed,
+        "profile": args.profile,
         "workload": gen.counters(),
         "admission": adm.counters(),
         "shed_fraction": round(adm.shed_total / max(adm.offered_total, 1),
@@ -167,6 +202,28 @@ def main() -> int:
         "governor": (pool.governor.trajectory_summary()
                      if pool.governor is not None else None),
     }
+    if pool.retry is not None:
+        # the closed-loop record: re-offer counts, the first-attempt vs
+        # retry admission split, goodput (unique requests that made it
+        # through per unique write arrival), and the retry-storm
+        # fingerprint — byte-identical per seed like shed_hash
+        from indy_plenum_tpu.common.metrics_collector import MetricsName
+
+        counters = pool.retry.counters()
+        readmitted = pool.metrics.stat(
+            MetricsName.INGRESS_RETRY_ADMITTED)
+        readmitted_n = int(readmitted.total) if readmitted else 0
+        record["retry"] = {
+            "max_attempts": args.retry_max,
+            "attempts": counters["reoffers"],
+            "requests_retried": counters["requests_retried"],
+            "exhausted": counters["exhausted"],
+            "retry_admitted": readmitted_n,
+            "first_attempt_admitted": adm.admitted_total - readmitted_n,
+            "goodput_fraction": round(
+                ordered / max(gen.writes, 1), 4),
+            "retry_hash": pool.retry.retry_hash(),
+        }
     if args.trace_out:
         pool.trace.dump(args.trace_out)
         record["trace_file"] = args.trace_out

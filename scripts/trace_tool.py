@@ -82,6 +82,7 @@ def _print_journey(detail: dict) -> None:
           f"s{j['batch'][1]} {str(j['batch'][2])[:12]}…)")
     print(f"  e2e={j['e2e']} complete={j['complete']} "
           f"attribution={j['attribution']}"
+          + (f" retries={j['retries']}" if j.get("retries") else "")
           + (f" via_catchup={j['catchup']}" if j.get("catchup") else "")
           + (f" proof_after={j['proof_after']}"
              if "proof_after" in j else ""))
@@ -109,10 +110,11 @@ def _print_journey(detail: dict) -> None:
 def _print_journey_table(record: dict) -> None:
     js = record["journeys"]
     e2e_w, e2e_r = js["e2e"]["write"], js["e2e"]["read"]
+    retried = f", retried={js['retried']}" if js.get("retried") else ""
     print(f"journeys: {js['complete']}/{js['count']} complete "
           f"(orphans={js['orphan_spans']}, pending={js['pending']}, "
-          f"shed={js['shed']}, via_catchup={js['catchup_journeys']}) "
-          f"hash={js['journey_hash'][:16]}…")
+          f"shed={js['shed']}, via_catchup={js['catchup_journeys']}"
+          f"{retried}) hash={js['journey_hash'][:16]}…")
     print(f"  e2e write: n={e2e_w['count']} p50={e2e_w['p50']} "
           f"p90={e2e_w['p90']} p99={e2e_w['p99']} max={e2e_w['max']}")
     if e2e_r["count"]:
@@ -144,7 +146,10 @@ def _print_journey_table(record: dict) -> None:
         catchup = (" catchup=" + ",".join(j["catchup"])
                    if j.get("catchup") else "")
         lane = f"lane={j['lane']} " if "lane" in j else ""
-        print(f"  {j['digest'][:16]}… {lane}e2e={j['e2e']} "
+        # closed-loop retry: how many re-offers this request took (its
+        # hops then carry the `retry` hop's backoff wait)
+        retries = f"retries={j['retries']} " if j.get("retries") else ""
+        print(f"  {j['digest'][:16]}… {lane}{retries}e2e={j['e2e']} "
               f"batch=v{j['batch'][0]}s{j['batch'][1]} "
               f"net={j['attribution']['network']} "
               f"queue={j['attribution']['queue']} "

@@ -106,7 +106,10 @@ def test_admission_per_client_fairness_cap():
     ok = ac.offer(_Req("cold-0"), client_id="cold")
     assert ok and ac.depth == 3
     _batch, shed = ac.drain()
-    assert {why for _r, why in shed} == {"client_cap"}
+    assert {why for _r, _cid, why in shed} == {"client_cap"}
+    # the drain's shed entries carry the shedding client's identity (the
+    # closed-loop retry driver re-offers under the SAME id)
+    assert {cid for _r, cid, _why in shed} == {"hot"}
     # caps reset after the drain (per-tick fairness, not a lifetime quota)
     assert ac.offer(_Req("hot-9"), client_id="hot")
 

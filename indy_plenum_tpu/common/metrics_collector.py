@@ -102,6 +102,15 @@ class MetricsName:
     GOVERNOR_SHARD_OCCUPANCY_EWMA = "governor.shard_occupancy_ewma"
     # execution
     COMMIT_TIME = "exec.commit_time"
+    # state-commit plane (state/sparse_merkle_state.py): per-3PC-batch
+    # tree hashes the one-walk batched commit actually performed (the
+    # O(delta) claim, measured — leaf + internal-node hashes, placement-
+    # independent) and the valid-request count flushed per batch; the
+    # per-state node-cache hit/miss totals live on the state object
+    # (cache_hits/cache_misses) and surface through profile_rbft's
+    # `state` block
+    STATE_COMMIT_HASHES = "state.commit_hashes"
+    STATE_COMMIT_BATCH_SIZE = "state.commit_batch_size"
     # catchup (chaos-hardened recovery plane): rounds completed, txns
     # fetched+applied, audit-proof verifications the leecher performed
     # on leeched batches (and the txns it REJECTED for failing them —

@@ -207,6 +207,30 @@ class Config:
     # run a BLS replica — there is nothing to capture without one.
     StateProofCacheWindows: int = 2
 
+    # --- state-commit plane (state/sparse_merkle_state.py) ----------------
+    # Batched O(delta) state commit: WriteRequestManager.apply_batch
+    # buffers a 3PC batch's writes and flushes them through ONE bottom-up
+    # SMT walk (last-write-wins dedupe, each touched internal node hashed
+    # once per batch) instead of a 256-hash path walk per write. False =
+    # the pre-batch sequential set() loop (roots are bit-identical either
+    # way — the state_gate asserts it).
+    StateCommitBatchEnabled: bool = True
+    # Write sets smaller than this skip the plan/wave machinery and apply
+    # sequentially — below it, prefix sharing has nothing to share and
+    # the plan-node overhead costs more than it saves.
+    StateCommitBatchMin: int = 4
+    # Placement of the per-level hash waves: "host" = hashlib loop,
+    # "device" = force the batched tpu/sha256 kernel, "auto" = the
+    # measured catchup offload policy decides per wave (DEVICE_MIN_BATCH
+    # floor; host SHA wins on XLA:CPU, the kernel wins on real TPU).
+    # Digests are bit-identical on either path — only nanoseconds move.
+    StateCommitBatchMode: str = "auto"
+    # Bounded LRU node cache fronting each state's KV store (entries are
+    # immutable content-addressed nodes, so the cache never invalidates).
+    # ~256 bytes/node -> the default is ~16 MB per stateful ledger.
+    # 0 disables.
+    StateNodeCacheSize: int = 65536
+
     # --- storage ----------------------------------------------------------
     KVStorageType: str = "sqlite"  # sqlite | memory
 

@@ -33,6 +33,7 @@ universe is the generator's hot-key space.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -111,14 +112,24 @@ class LedgerBacking:
     has durable agreement on — refreshing mid-window would serve roots a
     view change could still unwind."""
 
-    def __init__(self, ledger, bus=None):
+    # audit-path cache bound: on a long-lived pool the pinned
+    # (index, tree_size) keys are minted every stabilized window and
+    # never re-keyed, so an uncapped dict grows for the life of the
+    # node; LRU keeps the hot window working set and ~nothing else
+    PATH_CACHE_MAX = 4096
+
+    def __init__(self, ledger, bus=None,
+                 path_cache_max: Optional[int] = None):
         self._ledger = ledger
         self.tree_size = 0
         self.root = b""
         self.refreshes = 0
         # index -> path at the live snapshot; (index, size) -> path at a
-        # pinned historical size (the proof plane's window roots)
-        self._path_cache: Dict[object, List[bytes]] = {}
+        # pinned historical size (the proof plane's window roots).
+        # Bounded LRU: cleared on refresh(), capped between refreshes.
+        self._path_cache: "OrderedDict[object, List[bytes]]" = OrderedDict()
+        self._path_cache_max = (path_cache_max if path_cache_max is not None
+                                else self.PATH_CACHE_MAX)
         self.refresh()
         if bus is not None:
             from ..common.messages.internal_messages import (
@@ -153,16 +164,19 @@ class LedgerBacking:
         # trail the live tip mid-window); audit paths are per-tree-size,
         # so pinned sizes key the cache alongside the index
         if tree_size is None or tree_size == self.tree_size:
-            cached = self._path_cache.get(index)
-            if cached is None:
-                cached = self._ledger.audit_path(index + 1, self.tree_size)
-                self._path_cache[index] = cached
-            return cached
-        key = (index, tree_size)
+            key: object = index
+            pinned_size = self.tree_size
+        else:
+            key = (index, tree_size)
+            pinned_size = tree_size
         cached = self._path_cache.get(key)
-        if cached is None:
-            cached = self._ledger.audit_path(index + 1, tree_size)
-            self._path_cache[key] = cached
+        if cached is not None:
+            self._path_cache.move_to_end(key)
+            return cached
+        cached = self._ledger.audit_path(index + 1, pinned_size)
+        self._path_cache[key] = cached
+        if len(self._path_cache) > self._path_cache_max:
+            self._path_cache.popitem(last=False)
         return cached
 
 

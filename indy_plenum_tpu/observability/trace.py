@@ -79,6 +79,12 @@ PROOF_PHASE = ("proof", "3pc.ordered", "proof.window_signed")
 # the gap to rejoining 3PC with every leeched batch proof-verified
 # (``catchup.txns_leeched`` marks ride the same category, un-keyed).
 CATCHUP_PHASE = ("catchup", "catchup.started", "catchup.completed")
+# state-commit plane: a batch's execution (commit_batch returning its
+# staged record) → its state root durably advanced (the executed→proof
+# hop's first half). Joined per node on (view_no, pp_seq_no) — the
+# ``state.commit`` mark also carries the node's cumulative tree-hash
+# meter, so a dump shows hash cost alongside the latency chain.
+STATE_PHASE = ("state_commit", "3pc.executed", "state.commit")
 
 
 class TraceRecorder:
@@ -385,6 +391,31 @@ def phase_durations(events: List[Dict[str, Any]],
             (ev.get("node", ""), ev["key"][0], ev["key"][1]))
         if t0 is not None:
             out.setdefault(PROOF_PHASE[0], []).append(ev["ts"] - t0)
+    # state-commit phase: per node, each state.commit (key (view, seq))
+    # joins the SAME node's earliest 3pc.executed mark for that batch
+    # (key (view, seq, digest)) — how long after execution the state
+    # root was durably advanced (same cross-category join as the proof
+    # phase above)
+    executed_at: Dict[tuple, float] = {}
+    for ev in events:
+        if ev.get("cat") != "3pc" or ev["name"] != STATE_PHASE[1] \
+                or ev.get("key") is None or len(ev["key"]) < 2:
+            continue
+        if node is not None and ev.get("node", "") != node:
+            continue
+        k = (ev.get("node", ""), ev["key"][0], ev["key"][1])
+        if k not in executed_at or ev["ts"] < executed_at[k]:
+            executed_at[k] = ev["ts"]
+    for ev in events:
+        if ev.get("cat") != "state" or ev["name"] != STATE_PHASE[2] \
+                or ev.get("key") is None or len(ev["key"]) < 2:
+            continue
+        if node is not None and ev.get("node", "") != node:
+            continue
+        t0 = executed_at.get(
+            (ev.get("node", ""), ev["key"][0], ev["key"][1]))
+        if t0 is not None:
+            out.setdefault(STATE_PHASE[0], []).append(ev["ts"] - t0)
     # catchup phase: each leecher round's started -> completed arc,
     # joined per (node, round ordinal) like the 3PC lifecycle marks
     for (_node, _key), marks in sorted(

@@ -58,10 +58,12 @@ class NodeStorage:
 class LedgersBootstrap:
     def __init__(self, storage: Optional[NodeStorage] = None,
                  pool_genesis: Optional[List[Dict]] = None,
-                 domain_genesis: Optional[List[Dict]] = None):
+                 domain_genesis: Optional[List[Dict]] = None,
+                 config=None):
         self.storage = storage or NodeStorage()
         self.pool_genesis = pool_genesis or []
         self.domain_genesis = domain_genesis or []
+        self.config = config
         self.db = DatabaseManager()
         self.write_manager = WriteRequestManager(self.db)
         self.nym_handler: Optional[NymHandler] = None
@@ -81,7 +83,17 @@ class LedgersBootstrap:
             ledger.recover_tree()
             state = None
             if lid in STATEFUL_LEDGERS:
-                state = SparseMerkleState(kv=self.storage.state_stores[lid])
+                config = self.config
+                if config is not None:
+                    state = SparseMerkleState(
+                        kv=self.storage.state_stores[lid],
+                        node_cache_size=config.StateNodeCacheSize,
+                        commit_batch_enabled=config.StateCommitBatchEnabled,
+                        commit_batch_min=config.StateCommitBatchMin,
+                        commit_mode=config.StateCommitBatchMode)
+                else:
+                    state = SparseMerkleState(
+                        kv=self.storage.state_stores[lid])
             self.db.register_new_database(lid, ledger, state)
 
         self.nym_handler = NymHandler(self.db)

@@ -188,7 +188,8 @@ class Node:
         # --- persistence + execution -----------------------------------
         self.boot = LedgersBootstrap(
             storage=storage, pool_genesis=pool_genesis,
-            domain_genesis=domain_genesis).build()
+            domain_genesis=domain_genesis, config=self.config).build()
+        self.boot.write_manager.metrics = self.metrics
         self.executor = NodeExecutor(
             self.boot.write_manager,
             get_view_info=lambda: (self.data.view_no,
@@ -888,6 +889,16 @@ class Node:
                 key=(ordered.viewNo, ordered.ppSeqNo, ordered.digest))
         if staged is None:
             return
+        if self.trace.enabled:
+            # the executed -> durable-state-root hop (STATE_PHASE joins
+            # this to 3pc.executed per (view, seq) in phase_durations)
+            state = self.boot.db.get_state(staged.ledger_id)
+            self.trace.record(
+                "state.commit", cat="state", node=self.name,
+                key=(ordered.viewNo, ordered.ppSeqNo),
+                args={"ledger": staged.ledger_id,
+                      "hashes": state.hashes_total if state is not None
+                      else 0})
         ledger = self.boot.db.get_ledger(staged.ledger_id)
         valid = list(staged.batch.valid_digests)
         first_seq = ledger.size - len(valid) + 1

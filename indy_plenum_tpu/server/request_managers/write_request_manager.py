@@ -46,6 +46,9 @@ class WriteRequestManager:
         self.batch_handlers: Dict[int, LedgerBatchHandler] = {}
         self.audit_handler: Optional[AuditBatchHandler] = None
         self._staged: List[StagedBatch] = []
+        # set post-construction by the owning node (node.py / SimNode):
+        # per-batch state-commit meters land here when present
+        self.metrics = None
 
     # --- registration ---------------------------------------------------
 
@@ -128,6 +131,13 @@ class WriteRequestManager:
         state = self.db.get_state(batch.ledger_id)
         pre_state_root = state.head_hash if state is not None else None
         pre_uncommitted = ledger.uncommitted_size
+        # batched state commit: buffer the batch's writes and flush them
+        # through ONE bottom-up tree walk (SparseMerkleState.apply_batch)
+        # instead of a 256-hash path walk per write; reads during dynamic
+        # validation see the pending overlay, so the valid/invalid split
+        # (and therefore the root) is unchanged from sequential apply
+        pre_hashes = state.hashes_total if state is not None else 0
+        in_batch = state.begin_batch() if state is not None else False
         valid: List[Request] = []
         rejected: List[Tuple[Request, Exception]] = []
         try:
@@ -139,15 +149,25 @@ class WriteRequestManager:
                     continue
                 self.apply_request(req, batch.pp_time)
                 valid.append(req)
+            if in_batch:
+                state.flush_batch()
         except Exception:
             # discard down to the pre-batch size, not len(valid): the
             # failing request's txn may already be appended (apply_request
             # appends before update_state runs)
             ledger.discard_txns(ledger.uncommitted_size - pre_uncommitted)
             if state is not None and pre_state_root is not None:
+                # set_head_hash also discards any still-buffered writes
                 state.set_head_hash(pre_state_root)
             raise
         state_root = state.head_hash if state is not None else b""
+        if state is not None and self.metrics is not None:
+            from ...common.metrics_collector import MetricsName
+
+            self.metrics.add_event(MetricsName.STATE_COMMIT_HASHES,
+                                   state.hashes_total - pre_hashes)
+            self.metrics.add_event(MetricsName.STATE_COMMIT_BATCH_SIZE,
+                                   len(valid))
         txn_root = ledger.uncommitted_root_hash
         batch.state_root = state_root
         batch.txn_root = txn_root

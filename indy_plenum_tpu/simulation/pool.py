@@ -196,7 +196,9 @@ class SimNode:
             )
 
             self.boot = LedgersBootstrap(
-                storage=storage, domain_genesis=domain_genesis).build()
+                storage=storage, domain_genesis=domain_genesis,
+                config=config).build()
+            self.boot.write_manager.metrics = metrics
             self.executor = NodeExecutor(
                 self.boot.write_manager,
                 get_view_info=lambda: (self.data.view_no,
@@ -325,11 +327,20 @@ class SimNode:
             return  # already executed (re-ordered after view change)
         self.executed_upto = ordered.ppSeqNo
         self.ordered_log.append(ordered)
-        self.executor.commit_batch(ordered.ppSeqNo)
+        staged = self.executor.commit_batch(ordered.ppSeqNo)
         if self.trace.enabled:
             self.trace.record(
                 "3pc.executed", node=self.name,
                 key=(ordered.viewNo, ordered.ppSeqNo, ordered.digest))
+            if staged is not None and self.boot is not None:
+                # executed -> durable-state-root hop (STATE_PHASE join)
+                state = self.boot.db.get_state(staged.ledger_id)
+                self.trace.record(
+                    "state.commit", cat="state", node=self.name,
+                    key=(ordered.viewNo, ordered.ppSeqNo),
+                    args={"ledger": staged.ledger_id,
+                          "hashes": state.hashes_total
+                          if state is not None else 0})
 
     def _on_catchup_finished(self, msg, *args) -> None:
         # batches at/below the caught-up point were executed THROUGH the

@@ -322,6 +322,41 @@ verify_audit_paths = jax.jit(_verify_audit_paths)
 verify_audit_paths_indexed = jax.jit(_verify_audit_paths_indexed)
 
 
+def _merkle_node_hash_batch(left: jnp.ndarray,
+                            right: jnp.ndarray) -> jnp.ndarray:
+    """Platform-dispatched batched node hash: (B, 32) x2 -> (B, 32)."""
+    if _use_word_path():
+        return _words_to_bytes(_merkle_node_hash_words(
+            _bytes_to_words(left), _bytes_to_words(right)))
+    return merkle_node_hash(left, right)
+
+
+merkle_node_hash_batch = jax.jit(_merkle_node_hash_batch)
+
+
+def merkle_node_hash_bytes(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """Host-array seam for the state-commit hash waves.
+
+    (n, 32) uint8 host arrays in, resolved (n, 32) uint8 host array out —
+    one per-level wave of the batched SMT commit rides one call. Waves
+    are padded to the next power of two so jit specializes on
+    O(log max-wave) shapes instead of one compile per distinct wave size.
+    """
+    n = left.shape[0]
+    padded = 1
+    while padded < n:
+        padded <<= 1
+    if padded != n:
+        pad = np.zeros((padded - n, 32), np.uint8)
+        left = np.concatenate([left, pad])
+        right = np.concatenate([right, pad])
+    out = merkle_node_hash_batch(jnp.asarray(left), jnp.asarray(right))
+    # the wave result IS the product here (the commit cannot proceed to
+    # the next level without these digests), and commits run off the
+    # vote-plane tick loop — blocking is the contract, not a leak
+    return np.asarray(out)[:n]  # da: allow[device-sync] -- wave result is the product; state commit runs outside the consensus tick loop
+
+
 def sha256_host_oracle(data: bytes) -> bytes:  # pragma: no cover - test aid
     import hashlib
 

@@ -14,7 +14,10 @@ member x validator 2-axis quorum fabric; the record then carries
 ``shards``, ``mesh_shape`` and per-shard occupancy. ``--trace`` arms the consensus
 flight recorder: the span trace dumps to ``--trace-out`` (JSONL for
 ``scripts/trace_tool.py``) and the ``--json`` record gains
-``phase_latency`` percentiles + ``critical_path``. The determinism cross-check
+``phase_latency`` percentiles + ``critical_path``. ``--real-execution``
+profiles with real ledgers + SMT states; the record's ``state`` block
+then carries the batched state-commit plane's hashes/commit, node-cache
+hit rate and offload mode (``state: null`` otherwise). The determinism cross-check
 (``ordered_digests`` identical between the two modes) lives in
 ``tests/test_dispatch_plane.py``; the budget gate in
 ``scripts/check_dispatch_budget.py``.
@@ -77,7 +80,7 @@ BATCH = 160
 
 
 def _build_pool(n, k, tick_interval, adaptive=False, mesh=None,
-                trace=False, ingress_capacity=0):
+                trace=False, ingress_capacity=0, real_execution=False):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
@@ -89,7 +92,8 @@ def _build_pool(n, k, tick_interval, adaptive=False, mesh=None,
     # path (the admission plane guards the device auth batch)
     return SimPool(n_nodes=n, seed=11, config=config, device_quorum=True,
                    shadow_check=False, num_instances=k, mesh=mesh,
-                   trace=trace, sign_requests=ingress_capacity > 0)
+                   trace=trace, sign_requests=ingress_capacity > 0,
+                   real_execution=real_execution)
 
 
 def _run(pool, txns, profile=False):
@@ -184,6 +188,12 @@ def main():
                          "profiled pool then runs the SIGNED ingress "
                          "path and the --json record's ingress block "
                          "carries queue depth + admitted/shed totals")
+    ap.add_argument("--real-execution", action="store_true",
+                    help="profile with real ledgers + SMT states (NYM "
+                         "writes through the batched state-commit "
+                         "plane): the --json record's state block "
+                         "carries hashes/commit, node-cache hit rate "
+                         "and offload mode")
     ap.add_argument("--trace", action="store_true",
                     help="arm the consensus flight recorder: dumps the "
                          "span trace as JSONL (--trace-out) and the "
@@ -215,7 +225,8 @@ def main():
     pool = _build_pool(n, k, tick_interval=0.1,
                        adaptive=not args.static_tick, mesh=mesh,
                        trace=args.trace,
-                       ingress_capacity=args.ingress_capacity)
+                       ingress_capacity=args.ingress_capacity,
+                       real_execution=args.real_execution)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
@@ -295,6 +306,32 @@ def main():
         ingress = ingress or {}
         ingress["read_qps"] = round(read_qps.last, 1)
     record["ingress"] = ingress
+    # state-commit plane: the batched one-walk commit's cost surface,
+    # from node0's domain state (every honest node commits the same
+    # roots, so one node's meters are THE meters) — None when the run
+    # executed nothing real (no ledgers, no states)
+    state_block = None
+    node0 = pool.nodes[0]
+    if getattr(node0, "boot", None) is not None:
+        from indy_plenum_tpu.common.constants import DOMAIN_LEDGER_ID
+
+        st = node0.boot.db.get_state(DOMAIN_LEDGER_ID)
+        hashes_stat = pool.metrics.stat(MetricsName.STATE_COMMIT_HASHES)
+        batch_stat = pool.metrics.stat(MetricsName.STATE_COMMIT_BATCH_SIZE)
+        state_block = {
+            "hashes_total": st.hashes_total,
+            "hashes_per_commit": (round(hashes_stat.avg, 1)
+                                  if hashes_stat else None),
+            "commits": hashes_stat.count if hashes_stat else 0,
+            "writes_per_commit": (round(batch_stat.avg, 1)
+                                  if batch_stat else None),
+            "node_cache_hit_rate": round(st.cache_hit_rate(), 4),
+            "offload_mode": st.commit_mode,
+            "wave_host_hashes": st.wave_host_hashes,
+            "wave_device_hashes": st.wave_device_hashes,
+            "batches_applied": st.batches_applied,
+        }
+    record["state"] = state_block
     if trace_block is not None:
         record.update(trace_block)
     if not args.no_baseline:

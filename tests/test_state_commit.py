@@ -1,0 +1,322 @@
+"""Batched O(delta) state-commit plane (state/sparse_merkle_state.py).
+
+The contracts under test (README "State-commit plane"):
+
+- ``apply_batch`` is a pure optimization: random write sets (including
+  overwrite-within-batch and removes) produce roots BIT-IDENTICAL to
+  the sequential ``set()``/``remove()`` loop, on every placement arm
+  (host waves, forced device waves, ``mode='auto'``) — and with fewer
+  tree hashes (each touched internal node hashed once per batch);
+- ``generate_state_proof``/``verify_state_proof`` verify against
+  batch-produced roots, including HISTORICAL roots after ``commit()``;
+- ``verify_state_proof`` returns ``False`` on malformed untrusted input
+  (undecodable msgpack, short roots, non-bytes path elements,
+  wrong-length siblings) instead of raising;
+- the write-buffer overlay (``begin_batch``/``flush_batch``) keeps
+  reads-at-uncommitted coherent mid-batch, and the revert seams
+  (``set_head_hash``/``revert_to_head``) DISCARD buffered writes;
+- the LRU node cache and ``LedgerBacking``'s audit-path cache hold
+  their caps (bounded on a long-lived node);
+- end-to-end: a real-execution pool with the batch plane enabled orders
+  the same requests to the same roots as one with it disabled, and the
+  ``state.commit`` trace mark joins ``3pc.executed`` per (view, seq)
+  into the ``state_commit`` phase.
+"""
+import random
+
+from indy_plenum_tpu.common.constants import DOMAIN_LEDGER_ID
+from indy_plenum_tpu.common.metrics_collector import (
+    MetricsCollector,
+    MetricsName,
+)
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.simulation.pool import SimPool
+from indy_plenum_tpu.state.sparse_merkle_state import (
+    DEFAULTS,
+    DEPTH,
+    EMPTY_ROOT,
+    SparseMerkleState,
+    verify_state_proof,
+)
+
+
+def _random_batches(seed, n_rounds=5, keyspace=160, max_writes=60):
+    """Write sequences with hot-key collisions (overwrite-within-batch)
+    and removes of live keys — the shapes the dedupe and the unchanged-
+    subtree short-circuit must get right."""
+    rng = random.Random(seed)
+    live = set()
+    rounds = []
+    for _ in range(n_rounds):
+        writes = []
+        for _ in range(rng.randrange(1, max_writes)):
+            if live and rng.random() < 0.25:
+                k = rng.choice(sorted(live))
+                writes.append((k, None))
+                live.discard(k)
+            else:
+                k = b"k%d" % rng.randrange(keyspace)
+                writes.append((k, b"v%d" % rng.randrange(1 << 20)))
+                live.add(k)
+        rounds.append(writes)
+    return rounds
+
+
+def test_apply_batch_root_identical_to_sequential_and_cheaper():
+    for seed in (3, 17, 91):
+        seq = SparseMerkleState()
+        bat = SparseMerkleState(commit_mode="host")
+        for writes in _random_batches(seed):
+            for k, v in writes:
+                if v is None:
+                    seq.remove(k)
+                else:
+                    seq.set(k, v)
+            bat.apply_batch(writes)
+            assert bat.head_hash == seq.head_hash
+        # the O(delta) claim at property scale: strictly fewer hashes
+        assert bat.hashes_total < seq.hashes_total
+
+
+def test_apply_batch_device_and_auto_arms_bit_identical():
+    rng = random.Random(5)
+    writes = [(b"key%d" % rng.randrange(300), b"val%d" % i)
+              for i in range(150)]
+    host = SparseMerkleState(commit_mode="host")
+    dev = SparseMerkleState(commit_mode="device")
+    auto = SparseMerkleState(commit_mode="auto")
+    for st in (host, dev, auto):
+        st.apply_batch(writes)
+    assert host.head_hash == dev.head_hash == auto.head_hash
+    # the logical hash meter is placement-independent (it may ride
+    # traces/fingerprints; the wave_* placement meters may not)
+    assert host.hashes_total == dev.hashes_total == auto.hashes_total
+    assert dev.wave_device_hashes > 0 or dev.wave_host_hashes > 0
+
+
+def test_apply_batch_edge_cases():
+    st = SparseMerkleState()
+    assert st.apply_batch([]) == EMPTY_ROOT
+    # removes into an empty tree are a no-op, not a new root
+    assert st.apply_batch([(b"ghost", None)]) == EMPTY_ROOT
+    st.apply_batch([(b"a", b"1"), (b"b", b"2")])
+    r = st.head_hash
+    # rewriting identical values leaves the root (and the tree) alone
+    assert st.apply_batch([(b"a", b"1"), (b"b", b"2")]) == r
+    # last-write-wins within one batch
+    st2 = SparseMerkleState()
+    st2.apply_batch([(b"a", b"old"), (b"b", b"2"), (b"a", b"1")])
+    assert st2.head_hash == r
+    # removing everything returns to the empty root
+    st.apply_batch([(b"a", None), (b"b", None)])
+    assert st.head_hash == EMPTY_ROOT
+
+
+def test_proofs_verify_against_batch_roots_and_historical_roots():
+    st = SparseMerkleState(commit_mode="host")
+    st.apply_batch([(b"k%d" % i, b"v%d" % i) for i in range(40)])
+    st.commit()
+    old_root = st.committed_head_hash
+    st.apply_batch([(b"k%d" % i, b"NEW%d" % i) for i in range(0, 40, 2)]
+                   + [(b"k7", None)])
+    st.commit()
+    new_root = st.committed_head_hash
+    # current root: updated, removed (non-membership) and untouched keys
+    assert verify_state_proof(new_root, b"k0", b"NEW0",
+                              st.generate_state_proof(b"k0"))
+    assert verify_state_proof(new_root, b"k7", None,
+                              st.generate_state_proof(b"k7"))
+    assert verify_state_proof(new_root, b"k9", b"v9",
+                              st.generate_state_proof(b"k9"))
+    # historical root after commit(): content-addressed nodes keep every
+    # committed root readable and provable
+    p_old = st.generate_state_proof(b"k7", root=old_root)
+    assert st.get_for_root_hash(old_root, b"k7") == b"v7"
+    assert verify_state_proof(old_root, b"k7", b"v7", p_old)
+    assert not verify_state_proof(new_root, b"k7", b"v7", p_old)
+    assert not verify_state_proof(old_root, b"k7", b"tampered", p_old)
+
+
+def test_verify_state_proof_malformed_input_returns_false():
+    import msgpack
+
+    st = SparseMerkleState()
+    # several neighbours so the proof carries non-default (packed)
+    # siblings — otherwise the truncation mutations below are no-ops
+    st.apply_batch([(b"fill%d" % i, b"f%d" % i) for i in range(8)]
+                   + [(b"key", b"value")])
+    st.commit()
+    root = st.committed_head_hash
+    proof = st.generate_state_proof(b"key")
+    assert verify_state_proof(root, b"key", b"value", proof)
+    # every malformed shape must verify False, never raise
+    assert not verify_state_proof(b"short-root", b"key", b"value", proof)
+    assert not verify_state_proof(root[:-1], b"key", b"value", proof)
+    assert not verify_state_proof(root, "not-bytes", b"value", proof)
+    assert not verify_state_proof(root, None, b"value", proof)
+    assert not verify_state_proof(root, b"key", b"value", b"\x93garbage")
+    assert not verify_state_proof(root, b"key", b"value", 42)
+    assert not verify_state_proof(root, b"key", b"value",
+                                  msgpack.packb([1, 2], use_bin_type=True))
+    bitmap, packed = msgpack.unpackb(proof, raw=False)
+    for bad in (
+        msgpack.packb([bitmap[:-1], packed], use_bin_type=True),
+        msgpack.packb([bitmap, packed[:-1]], use_bin_type=True),
+        msgpack.packb([bitmap, packed + [b"x" * 31]], use_bin_type=True),
+        msgpack.packb([bitmap, ["not-bytes"] * len(packed)],
+                      use_bin_type=True),
+        msgpack.packb([None, packed], use_bin_type=True),
+    ):
+        assert not verify_state_proof(root, b"key", b"value", bad)
+
+
+def test_batch_overlay_reads_and_revert_discard():
+    st = SparseMerkleState()
+    st.set(b"a", b"committed")
+    st.commit()
+    assert st.begin_batch()
+    st.set(b"a", b"staged")
+    st.set(b"b", b"new")
+    st.remove(b"a")
+    # uncommitted reads see the pending overlay (dynamic validation
+    # inside a 3PC batch observes earlier same-batch writes)...
+    assert st.get(b"a") is None
+    assert st.get(b"b") == b"new"
+    # ...committed reads do not
+    assert st.get(b"a", is_committed=True) == b"committed"
+    root = st.head_hash  # flushes + closes the batch
+    assert not st.in_batch
+    ref = SparseMerkleState()
+    ref.set(b"b", b"new")
+    assert root == ref.head_hash
+    # set_head_hash is the exception/revert path: buffered writes die
+    st.begin_batch()
+    st.set(b"z", b"doomed")
+    st.set_head_hash(root)
+    assert st.get(b"z") is None and not st.in_batch
+    st.begin_batch()
+    st.set(b"z", b"doomed-too")
+    st.revert_to_head()
+    assert st.get(b"z") is None and not st.in_batch
+    # the knob: a disabled plane refuses to open a batch
+    off = SparseMerkleState(commit_batch_enabled=False)
+    assert not off.begin_batch()
+    assert not off.in_batch
+
+
+def test_commit_batch_min_small_batches_apply_sequentially():
+    st = SparseMerkleState(commit_batch_min=10)
+    ref = SparseMerkleState()
+    writes = [(b"x%d" % i, b"y%d" % i) for i in range(4)]
+    st.apply_batch(writes)
+    for k, v in writes:
+        ref.set(k, v)
+    assert st.head_hash == ref.head_hash
+    # below the min the sequential path runs: hash counts match exactly
+    assert st.hashes_total == ref.hashes_total
+
+
+def test_node_cache_bounded_lru():
+    # cap must exceed one full root-to-leaf walk (DEPTH nodes) or a
+    # sequential re-walk evicts its own path before revisiting it
+    cap = DEPTH * 2
+    st = SparseMerkleState(node_cache_size=cap)
+    st.apply_batch([(b"n%d" % i, b"v%d" % i) for i in range(50)])
+    st.commit()
+    for i in range(50):
+        assert st.get(b"n%d" % i) == b"v%d" % i
+    assert st.node_cache_len <= cap
+    assert st.cache_misses > 0
+    # the last-read key's path is still resident: re-reading it hits
+    h0 = st.cache_hits
+    st.get(b"n49")
+    assert st.cache_hits > h0
+    # 0 disables caching entirely
+    off = SparseMerkleState(node_cache_size=0)
+    off.set(b"k", b"v")
+    off.commit()
+    off.get(b"k")
+    assert off.node_cache_len == 0
+
+
+def test_defaults_table_shape():
+    assert len(DEFAULTS) == DEPTH + 1
+    assert DEFAULTS[0] == EMPTY_ROOT
+
+
+def test_ledger_backing_path_cache_lru_capped_and_cleared_on_refresh():
+    from indy_plenum_tpu.ingress.read_service import LedgerBacking
+    from indy_plenum_tpu.ledger.ledger import Ledger
+
+    ledger = Ledger()
+    for i in range(40):
+        ledger.add({"type": "1", "v": i})
+    backing = LedgerBacking(ledger, path_cache_max=8)
+    # live-snapshot and pinned-historical keys both count against the cap
+    for i in range(30):
+        backing.path(i)
+        backing.path(i % 15, tree_size=20 + (i % 10))
+    assert len(backing._path_cache) <= 8
+    # LRU: the hot key survives the sweep
+    hot = backing.path(0)
+    for i in range(1, 8):
+        backing.path(i)
+        backing.path(0)
+    assert backing.path(0) is hot
+    # refresh on growth clears the cache outright
+    ledger.add({"type": "1", "v": 99})
+    backing.refresh()
+    assert len(backing._path_cache) == 0
+    assert backing.path(3) == ledger.audit_path(4, ledger.size)
+
+
+def _real_pool(seed, overrides=None, trace=False):
+    cfg = {"CHK_FREQ": 5, "LOG_SIZE": 15,
+           "Max3PCBatchSize": 10, "Max3PCBatchWait": 0.05}
+    cfg.update(overrides or {})
+    metrics = MetricsCollector()
+    pool = SimPool(4, seed=seed, config=getConfig(cfg),
+                   real_execution=True, trace=trace, metrics=metrics)
+    for i in range(12):
+        pool.submit_request(i)
+    pool.run_for(15)
+    assert pool.honest_nodes_agree()
+    return pool
+
+
+def test_pool_batched_commit_matches_disabled_and_meters():
+    batched = _real_pool(29)
+    sequential = _real_pool(29, {"StateCommitBatchEnabled": False})
+    # end-to-end bit-identity: same seed, same requests, same roots —
+    # whether state committed through one walk per batch or per write
+    assert batched.ordered_hash() == sequential.ordered_hash()
+    for nb, ns in zip(batched.nodes, sequential.nodes):
+        sb = nb.boot.db.get_state(DOMAIN_LEDGER_ID)
+        ss = ns.boot.db.get_state(DOMAIN_LEDGER_ID)
+        assert sb.committed_head_hash == ss.committed_head_hash
+        assert sb.batches_applied > 0
+        assert ss.batches_applied == 0  # knob really disabled the plane
+        # one walk per batch beats one walk per write
+        assert sb.hashes_total < ss.hashes_total
+    # the per-batch meters landed on the pool collector
+    stat = batched.metrics.stat(MetricsName.STATE_COMMIT_HASHES)
+    assert stat is not None and stat.count > 0
+    assert batched.metrics.stat(
+        MetricsName.STATE_COMMIT_BATCH_SIZE) is not None
+
+
+def test_state_commit_trace_phase_joined():
+    from indy_plenum_tpu.observability.trace import (
+        STATE_PHASE,
+        phase_durations,
+    )
+
+    pool = _real_pool(31, trace=True)
+    events = pool.trace.events()
+    marks = [e for e in events if e["name"] == "state.commit"]
+    assert marks and all(e["cat"] == "state" for e in marks)
+    assert all(e["args"]["hashes"] > 0 for e in marks)
+    phases = phase_durations(events)
+    samples = phases.get(STATE_PHASE[0])
+    assert samples, "state_commit phase did not join"
+    assert all(d >= 0.0 for d in samples)

@@ -81,6 +81,12 @@ class ChaosReport:
     # per-seeder throttle meters proving the pool kept ordering while
     # it seeded the returning victim
     ingress: Dict[str, Any] = field(default_factory=dict)
+    # geo plane (edge_poison scenarios): the cache-poisoning closing
+    # check's record — tampered/caught counts on the byzantine edge,
+    # the honest edge's verification record, and the fallback
+    # accounting proving every poisoned reply was re-served from the
+    # origin after verification caught it
+    edge: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def failed(self) -> List[str]:
@@ -140,6 +146,7 @@ class ChaosReport:
             "flight_recorder": self.flight_recorder,
             "journeys": self.journeys,
             "lanes": self.lanes,
+            "edge": self.edge,
         }
 
     def to_json(self, indent: int = 2) -> str:
@@ -203,6 +210,15 @@ class ChaosReport:
                 f"router={ln.get('router', {}).get('distribution')} "
                 f"sealed_window={barrier.get('sealed_window')} "
                 f"seal_fp={str(barrier.get('seal_fingerprint'))[:16]}…")
+        if self.edge:
+            poisoned = self.edge.get("poisoned") or {}
+            honest = self.edge.get("honest") or {}
+            lines.append(
+                f"  edge: tampered={poisoned.get('tampered')} "
+                f"caught={poisoned.get('caught')} "
+                f"fallbacks={poisoned.get('origin_fallbacks')} "
+                f"honest_verified={honest.get('verified')}/"
+                f"{honest.get('served')}")
         if self.trace_hash is not None:
             dumped = ", ".join(sorted({d.get("reason", "?")
                                        for d in self.flight_recorder})) \

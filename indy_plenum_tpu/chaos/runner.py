@@ -158,6 +158,102 @@ def _catchup_verdicts(pool, plan, scenario, block) -> list:
     return out
 
 
+def _edge_block(pool, scenario, seed: int) -> Dict[str, object]:
+    """The geo plane's cache-poisoning closing check (``edge_poison``
+    scenarios): replicate the last stabilized window's proof-attached
+    replies into TWO region-local edge caches, arm deterministic
+    tampering on one, route the same read set through both via
+    :class:`~indy_plenum_tpu.proofs.edge_cache.GeoReadFabric`, and
+    record what client verification caught. Verification — not the
+    cache — is the security boundary, so every tampered reply must fail
+    offline verification and be re-served from the origin validator."""
+    if not scenario.edge_poison or pool.bls_keys is None:
+        return {}
+    from ..proofs.edge_cache import EdgeProofCache, GeoReadFabric
+    from ..simulation.sim_network import RegionLatencyMatrix
+
+    origin = pool.make_read_service("node0", mode="host")
+    if origin.proof_cache is None or origin.proof_cache.current() is None:
+        return {"error": "no stabilized proof window to replicate"}
+    entry = origin.proof_cache.current()
+    n_reads = min(entry.tree_size, 24)
+    for i in range(n_reads):
+        origin.submit(i)
+    replies = origin.drain()
+    keys = {name: pk for name, (kp, pk, pop) in pool.bls_keys.items()}
+    quorum = len(pool.validators) - (len(pool.validators) - 1) // 3
+    matrix = RegionLatencyMatrix(2, seed=seed, intra_band=(0.01, 0.05),
+                                 wan_band=(0.08, 0.25))
+    clock = pool.timer.get_current_time
+    block: Dict[str, object] = {"window": list(entry.window),
+                                "replicated": len(replies)}
+    for label, poison in (("honest", False), ("poisoned", True)):
+        edge = EdgeProofCache(region=1, keep_windows=2,
+                              max_entries=4096, clock=clock)
+        edge.replicate(entry.window, replies)
+        if poison:
+            edge.poison(seed)
+        fabric = GeoReadFabric(
+            origin, matrix, keys, min_participants=quorum, n_regions=2,
+            origin_region=0, edges={1: edge}, seed=seed, clock=clock)
+        for i in range(n_reads):
+            fabric.submit(2 * i + 1, i)  # every client homes in region 1
+        answered = fabric.drain()
+        counters = fabric.counters()
+        block[label] = {
+            "served": counters["served"],
+            "edge_served": counters["edge_served"],
+            "verified": sum(b["verified"] for b
+                            in counters["regions"].values()),
+            "tampered": edge.tampered_total,
+            "caught": counters["verify_caught"],
+            "origin_fallbacks": counters["origin_served"],
+            "stale_fallbacks": counters["stale_fallbacks"],
+            "edge_serve_pairings": counters["edge_serve_pairings"],
+            "answered": len(answered),
+        }
+    return block
+
+
+def _edge_verdicts(scenario, block) -> list:
+    """Poisoning verdicts from the edge closing check: catching is
+    asserted NON-VACUOUSLY (tampered > 0), and the honest arm proves
+    the check passes for the right reason, not by rejecting everything."""
+    if not scenario.edge_poison:
+        return []
+    if not block or "poisoned" not in block:
+        return [InvariantResult(
+            "edge_poisoning", False,
+            str(block.get("error")) if block
+            else "edge closing check did not run")]
+    poisoned, honest = block["poisoned"], block["honest"]
+    tampered, caught = poisoned["tampered"], poisoned["caught"]
+    return [
+        InvariantResult(
+            "edge_poisoning",
+            tampered > 0 and caught == tampered
+            and poisoned["origin_fallbacks"] == tampered
+            and poisoned["answered"] == poisoned["served"],
+            f"byzantine edge tampered {tampered} replies; client "
+            f"verification caught {caught}/{tampered}, "
+            f"{poisoned['origin_fallbacks']} re-served from the origin"
+            if tampered else
+            "no reply was tampered — the poisoned edge was never "
+            "exercised (vacuous)"),
+        InvariantResult(
+            "edge_honest_serve",
+            honest["tampered"] == 0 and honest["served"] > 0
+            and honest["verified"] == honest["served"]
+            and honest["edge_served"] == honest["served"]
+            and honest["edge_serve_pairings"] == 0,
+            f"honest edge served {honest['edge_served']}/"
+            f"{honest['served']} reads region-locally, "
+            f"{honest['verified']} verified offline, "
+            f"{honest['edge_serve_pairings']} pairings on the edge "
+            "serve path"),
+    ]
+
+
 class _LaneZeroFacade:
     """The fault plan's view of a :class:`~indy_plenum_tpu.lanes.pool
     .LanedPool`: faults target lane 0 (the scenario's fault lane — its
@@ -473,12 +569,13 @@ def run_scenario(scenario: "str | Scenario", seed: int,
 
     results = checker.check_all(
         probes=3, liveness_timeout=scenario.liveness_timeout)
-    # metrics snapshot BEFORE the proof-read closing check: the read
-    # service records a wall-clock qps gauge, which must not leak
-    # nondeterminism into the replayable report
+    # metrics snapshot before the closing checks: they serve extra reads
+    # whose events belong to the checks, not the scenario's record
     metrics_summary = pool.metrics.summary()
     catchup_block = _catchup_block(pool, plan, scenario, leech_floor)
     results.extend(_catchup_verdicts(pool, plan, scenario, catchup_block))
+    edge_block = _edge_block(pool, scenario, seed)
+    results.extend(_edge_verdicts(scenario, edge_block))
 
     # overload robustness plane: the saturation forensic record — the
     # shed/retry fingerprints let the overload gate assert byte-
@@ -543,6 +640,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
             if getattr(nd, "monitor", None) is not None},
         catchup=catchup_block,
         ingress=ingress_block,
+        edge=edge_block,
         byzantine_nodes=sorted(plan.byzantine_nodes),
         periodic_checks=len(scheduler.probe_results),
         first_violation=scheduler.first_violation,

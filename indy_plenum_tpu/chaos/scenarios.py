@@ -73,6 +73,13 @@ class Scenario:
     require_rejection: bool = False
     require_retries: bool = False
     proof_read: bool = False
+    # geo plane: arm the cache-poisoning closing check — a byzantine
+    # region-local edge cache tampers every proof reply it serves, and
+    # the client verification loop must catch 100% of it (asserted
+    # non-vacuously, alongside an honest edge serving the same reads).
+    # Needs bls=True + real_execution=True (the edge replicates a real
+    # stabilized window's proof-attached replies).
+    edge_poison: bool = False
     # ordering lanes: > 1 routes the scenario through a LanedPool of
     # this many lanes — faults apply INSIDE lane 0 (the runner's fault
     # facade), per-lane safety aggregates, the cross_lane invariant
@@ -536,6 +543,41 @@ register(Scenario(
         "CatchupSeederThrottleTxnsPerSec": 40.0,
         "CatchupSeederThrottleBurst": 10,
     }))
+
+
+# --- geo plane: edge cache poisoning -------------------------------------
+#
+# The edge proof tier (proofs/edge_cache.py) is UNTRUSTED by design:
+# verification, not the cache, is the security boundary. This arc proves
+# that boundary non-vacuously: after a clean run seals checkpoint
+# windows, the closing check replicates the last window's proof-attached
+# replies into TWO region-local edges, arms deterministic tampering on
+# one (leaf flips / root flips / corrupted multi-sigs), serves the same
+# read set from both, and asserts (a) the client verification loop
+# catches EVERY tampered reply and falls back to the origin validator,
+# (b) the honest edge's replies all verify, (c) the tamper counter is
+# non-zero (the check actually exercised the byzantine path).
+
+def _edge_cache_poisoning(rng: random.Random, validators: List[str]) -> List:
+    # the byzantine actor lives OUTSIDE consensus — a poisoned edge in
+    # the closing check, not a network fault
+    return []
+
+
+register(Scenario(
+    name="edge_cache_poisoning",
+    build=_edge_cache_poisoning,
+    description="a byzantine region-local edge cache tampers every proof "
+                "reply it serves: clients catch 100% by offline "
+                "verification and fall back to the origin validator, "
+                "while an honest edge serving the same reads stays fully "
+                "verifiable (all asserted, non-vacuously)",
+    run_seconds=20.0,
+    liveness_timeout=30.0,
+    real_execution=True,
+    bls=True,
+    edge_poison=True,
+    config_overrides=dict(_CATCHUP_CONFIG)))
 
 
 # --- the checker-vacuity proof -------------------------------------------

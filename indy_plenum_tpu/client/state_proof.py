@@ -126,6 +126,45 @@ def verify_proved_read(read,
                                  now=now, max_age=max_age)
 
 
+def verify_read_binding(read) -> bool:
+    """Bindings (1)+(2) of :func:`verify_proved_read` WITHOUT the
+    multi-signature pairing check: the RFC 6962 audit path binds
+    ``(index, leaf)`` to ``root`` at ``tree_size``, and the attached
+    multi-sig's signed value names exactly that root.
+
+    The geo plane's edge clients use this to amortize the pairing cost
+    across a window (README "Planet-scale read fabric"): ONE full
+    :func:`verify_proved_read` per distinct (window, signature,
+    participants) establishes pool trust in the signed root; every
+    further reply claiming the SAME signed material needs only these
+    two offline bindings — a tampered leaf, path, or root fails here,
+    and a reply smuggling a DIFFERENT multi-sig misses the caller's
+    trust key and pays the full verification (which then fails)."""
+    ms = getattr(read, "multi_sig", None)
+    if ms is None:
+        return False
+    if not isinstance(read.root, (bytes, bytearray)):
+        return False
+    from ..ledger.merkle_verifier import STH, MerkleVerifier
+
+    try:
+        ok = MerkleVerifier().verify_leaf_inclusion(
+            read.leaf, read.index, read.path,
+            STH(read.tree_size, read.root))
+    except (ValueError, IndexError, TypeError):
+        return False
+    if not ok:
+        return False
+    if isinstance(ms, MultiSignature):
+        txn_root = ms.value.txn_root_hash
+    else:
+        try:
+            txn_root = dict(ms).get("value", {}).get("txn_root_hash")
+        except (TypeError, ValueError, AttributeError):
+            return False
+    return txn_root == b58encode(read.root)
+
+
 def verify_pool_multi_sig(ms: MultiSignature,
                           pool_bls_keys: Dict[str, str],
                           min_participants: int,

@@ -98,6 +98,39 @@ class Config:
     OUTGOING_BATCH_SIZE: int = 100
     MSG_LEN_LIMIT: int = 128 * 1024
 
+    # --- geo plane: regional latency realism (simulation/sim_network.py) --
+    # Number of simulated regions. 0 = single-region (the pre-geo
+    # behaviour: one uniform latency band, byte-identical to every
+    # earlier seed — region mode consumes exactly the same ONE rng draw
+    # per delivery, only the band bounds change). > 0 assigns node i to
+    # region i % RegionCount and draws cross-region deliveries from the
+    # pair's seeded WAN band instead of the intra-region fast band.
+    RegionCount: int = 0
+    # WAN envelope: every cross-region pair gets a deterministic
+    # (lo, hi) latency band inside [RegionWanMinLatency,
+    # RegionWanMaxLatency), derived from RegionLatencySeed — the
+    # inter-region latency matrix. Intra-region pairs keep the
+    # SimNetwork min/max_latency fast band.
+    RegionWanMinLatency: float = 0.08
+    RegionWanMaxLatency: float = 0.25
+    # Seed for the pair-band matrix. 0 = simulation pools fall back to
+    # the pool seed, so a seeded run replays the identical matrix.
+    RegionLatencySeed: int = 0
+
+    # --- geo plane: edge proof-cache tier (proofs/edge_cache.py) ----------
+    # Region-local UNTRUSTED replicas of the last sealed windows'
+    # proof-attached replies. The edge holds at most this many sealed
+    # windows' corpora; older windows evict when a new seal replicates
+    # in (the CheckpointStabilized invalidation rule).
+    EdgeProofCacheKeepWindows: int = 2
+    # Bounded LRU entry cap per edge (replies across all held windows).
+    # Misses fall back to the home-region validator over the WAN.
+    EdgeProofCacheMaxEntries: int = 4096
+    # Freshness bound clients fold into verify_proved_read against edge
+    # replies: a held window older than this (vs the client's clock) is
+    # treated as stale and the client falls back to the origin.
+    EdgeProofCacheMaxAge: float = 300.0
+
     # --- device plane (TPU) ----------------------------------------------
     # Quorum evaluation cadence when the device vote plane is authoritative.
     # 0 = evaluate on every message (one padded device flush per query —

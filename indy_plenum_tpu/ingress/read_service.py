@@ -196,8 +196,11 @@ class _QueuedRead:
 class ReadService:
     """Batches GET-style reads and answers them with device-verified
     proofs. ``clock`` (the pool's virtual clock) timestamps the
-    ``ingress.read`` trace marks so traces stay deterministic; the
-    wall-clock spent serving accumulates host-side only (``read_qps``).
+    ``ingress.read`` trace marks so traces stay deterministic, and
+    ``read_qps`` derives from the SAME virtual clock (served total over
+    the first→last serving-drain span), so snapshots and reports replay
+    byte-identically; the wall-clock spent serving still accumulates
+    host-side (``serve_wall_s``) for wall-throughput benches only.
 
     ``proof_cache`` (a :class:`~indy_plenum_tpu.proofs.checkpoint_cache
     .CheckpointProofCache`) attaches the state-proof plane: drains serve
@@ -216,7 +219,8 @@ class ReadService:
     def __init__(self, backing, clock: Optional[Callable[[], float]] = None,
                  metrics=None, trace=None, max_batch: int = 16384,
                  mode: str = "auto", proof_cache=None,
-                 capacity: int = 0, seed: int = 0, name: str = ""):
+                 capacity: int = 0, seed: int = 0, name: str = "",
+                 region: Optional[int] = None):
         from ..common.metrics_collector import MetricsCollector
         from ..observability.trace import NULL_TRACE
 
@@ -237,6 +241,10 @@ class ReadService:
         # sharing one recorder (or N merged per-node dumps) pair their
         # submitted/served FIFO windows independently in causal.py
         self.name = name
+        # geo plane: the service's home region rides the read.submitted
+        # marks so causal.py segregates read e2e per region (None =
+        # untagged — single-region dumps keep their exact bytes)
+        self.region = region
         self.max_batch = int(max_batch)
         self._queue: List[int] = []
         self.admission = None
@@ -250,8 +258,25 @@ class ReadService:
         self.verified_total = 0
         self.proofs_attached_total = 0
         self.serve_wall_s = 0.0
+        # read_qps span on the VIRTUAL clock: first/last drain instant
+        # that actually served reads — a pure function of the seeded
+        # schedule, so every surface reporting read_qps replays
+        # byte-identically (the wall meter above stays wall-only)
+        self._vt_first_serve: Optional[float] = None
+        self._vt_last_serve: Optional[float] = None
 
     # ------------------------------------------------------------------
+
+    def reset_serve_meters(self) -> None:
+        """Zero the serve accounting — benches call this after kernel
+        warm-up so warm-up drains pollute neither the wall meter nor the
+        virtual read_qps span."""
+        self.served_total = 0
+        self.verified_total = 0
+        self.proofs_attached_total = 0
+        self.serve_wall_s = 0.0
+        self._vt_first_serve = None
+        self._vt_last_serve = None
 
     @property
     def depth(self) -> int:
@@ -289,8 +314,10 @@ class ReadService:
                 # these FIFO per service, giving per-read e2e without a
                 # per-item id on the serve path. Unbounded mode only —
                 # a bounded queue's seeded shed would break the pairing.
-                self.trace.record("read.submitted", cat="read",
-                                  node=self.name)
+                self.trace.record(
+                    "read.submitted", cat="read", node=self.name,
+                    args=({"region": self.region}
+                          if self.region is not None else None))
             return True
         self._read_seq += 1
         return self.admission.offer(_QueuedRead(self._read_seq, idx))
@@ -373,26 +400,36 @@ class ReadService:
         # da: allow[nondet-source] -- serve_wall_s meter close (see t0 above)
         self.serve_wall_s += time.perf_counter() - t0
         self.served_total += len(queued)
+        now = self._clock()
+        if self._vt_first_serve is None:
+            self._vt_first_serve = now
+        self._vt_last_serve = now
         if ms_dict is not None:
             self.proofs_attached_total += len(queued)
         self.metrics.add_event(MetricsName.READ_BATCH_SIZE, len(queued))
         self.metrics.add_event(MetricsName.READ_SERVED, len(queued))
-        if self.serve_wall_s > 0:
-            self.metrics.add_event(
-                MetricsName.READ_QPS,
-                self.served_total / self.serve_wall_s)
+        # qps on the VIRTUAL serve span (zero until a second serving
+        # drain opens it): deterministic per seed, so the metric stream
+        # — and every snapshot built from it — replays byte-identically
+        span = self._vt_last_serve - self._vt_first_serve
+        if span > 0:
+            self.metrics.add_event(MetricsName.READ_QPS,
+                                   self.served_total / span)
         return out
 
     # ------------------------------------------------------------------
 
     def counters(self) -> Dict[str, object]:
-        qps = (self.served_total / self.serve_wall_s
-               if self.serve_wall_s > 0 else 0.0)
+        # read_qps from the virtual serve span — deterministic per seed
+        # (the wall meter serve_wall_s stays an attribute for
+        # wall-throughput benches, OUT of the replayable record)
+        span = ((self._vt_last_serve - self._vt_first_serve)
+                if self._vt_first_serve is not None else 0.0)
+        qps = self.served_total / span if span > 0 else 0.0
         out = {
             "served": self.served_total,
             "verified": self.verified_total,
             "pending": self.depth,
-            "serve_wall_s": round(self.serve_wall_s, 4),
             "read_qps": round(qps, 1),
             "proofs_attached": self.proofs_attached_total,
         }

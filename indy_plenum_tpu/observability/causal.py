@@ -130,6 +130,11 @@ class _Extract:
         # (view 0, seq 5) never cross-pollute, and the seal instant
         # becomes each journey's "barrier" hop
         self.req_lane: Dict[str, int] = {}           # digest -> lane
+        # geo plane: marks submitted with a home region carry
+        # args["region"] — journeys inherit it (mirrors lane), and the
+        # read FIFO pairs it through so read e2e segregates per region
+        self.req_region: Dict[str, int] = {}         # digest -> region
+        self.read_e2e_by_region: Dict[int, List[float]] = {}
         self._barrier_ready: Dict[tuple, int] = {}   # (lane, win) -> seq
         self.barrier_sealed: Dict[int, float] = {}   # window -> seal ts
         # batch digest -> {"keys": set[(v, s)], "reqIdr": [...],
@@ -173,6 +178,8 @@ class _Extract:
                     self.retry_count.get(key[0], 0) + 1
             if "lane" in args and key[0] not in self.req_lane:
                 self.req_lane[key[0]] = args["lane"]
+            if "region" in args and key[0] not in self.req_region:
+                self.req_region[key[0]] = args["region"]
         elif cat == "3pc" and key and len(key) >= 3 \
                 and name in self._LIFECYCLE:
             b = self.batches.setdefault(
@@ -240,13 +247,18 @@ class _Extract:
         elif cat == "read":
             svc = ev.get("node", "")
             if name == "read.submitted":
-                self._read_pending.setdefault(svc, []).append(ts)
+                self._read_pending.setdefault(svc, []).append(
+                    (ts, args.get("region")))
             elif name == "read.served":
                 n = int(args.get("n", 0))
                 pending = self._read_pending.get(svc, [])
                 take = pending[:n]
                 del pending[:n]
-                self.read_e2e.extend(ts - t0 for t0 in take)
+                for t0, region in take:
+                    self.read_e2e.append(ts - t0)
+                    if region is not None:
+                        self.read_e2e_by_region.setdefault(
+                            region, []).append(ts - t0)
         elif cat == "chaos":
             if name.startswith("begin "):
                 self._fault_open[name[6:]] = ts
@@ -425,6 +437,10 @@ def _build_journeys(events: List[Dict[str, Any]]
                 # ordering lanes: which lane ordered it (absent in
                 # single-lane dumps — existing tables stay byte-stable)
                 **({"lane": lane} if lane is not None else {}),
+                # geo plane: the submitting client's home region (absent
+                # in single-region dumps — tables stay byte-stable)
+                **({"region": x.req_region[digest]}
+                   if digest in x.req_region else {}),
                 # closed-loop retry: how many re-offers it took (absent
                 # for first-attempt requests — retry-free tables stay
                 # byte-stable)
@@ -466,6 +482,10 @@ def _build_journeys(events: List[Dict[str, Any]]
              "read_e2e": x.read_e2e,
              "fault_windows": [[_r(a), _r(b)]
                                for a, b in x.fault_windows]}
+    if x.read_e2e_by_region:
+        # geo plane only — single-region dumps stay byte-compatible
+        built["read_e2e_by_region"] = dict(
+            sorted(x.read_e2e_by_region.items()))
     return built, x
 
 
@@ -555,6 +575,30 @@ def journey_summary(events: List[Dict[str, Any]],
                 1 for j in journeys
                 if any(h["hop"] == "barrier" for h in j["hops"])),
         }
+    # geo plane: per-region e2e percentiles for writes (journeys whose
+    # marks carried a home region) and reads (region-tagged read FIFO
+    # pairs) — absent for single-region dumps, so existing rollups stay
+    # byte-stable
+    region_ids = sorted({j["region"] for j in journeys if "region" in j})
+    read_regions = built.get("read_e2e_by_region") or {}
+    if region_ids or read_regions:
+        regions = {
+            "count": len(set(region_ids) | set(read_regions)),
+            "with_region": sum(1 for j in journeys if "region" in j),
+        }
+        if region_ids:
+            regions["journeys_per_region"] = {
+                str(r): sum(1 for j in journeys if j.get("region") == r)
+                for r in region_ids}
+            regions["e2e_per_region"] = {
+                str(r): _pct_block([j["e2e"] for j in complete
+                                    if j.get("region") == r])
+                for r in region_ids}
+        if read_regions:
+            regions["read_e2e_per_region"] = {
+                str(r): _pct_block(s)
+                for r, s in sorted(read_regions.items())}
+        out["regions"] = regions
     windows = built["fault_windows"]
     if windows:
         def _in_fault(j):

@@ -11,7 +11,13 @@ Two cooperating parts (README "State-proof plane"):
 - :mod:`.batch_verify` — random-linear-combination verification of K
   aggregate signatures across multiple roots/windows in one combined
   pairing pass (seedable for deterministic replay), so proofs/sec scales
-  with batch size instead of the per-root cycle cost.
+  with batch size instead of the per-root cycle cost;
+- :mod:`.edge_cache` — the geo plane's edge tier: region-local
+  UNTRUSTED replicas of the last sealed window's proof-attached
+  replies (``EdgeProofCache``) plus the region-routing client loop
+  (``GeoReadFabric``) that verifies every edge reply offline and falls
+  back to the origin validator over the WAN — verification, not the
+  cache, is the security boundary (README "Planet-scale read fabric").
 
 The client side closes the loop in
 :func:`indy_plenum_tpu.client.state_proof.verify_proved_read`: a reply
@@ -19,9 +25,12 @@ from ONE node verifies with nothing but the pool's BLS keys.
 """
 from .batch_verify import seeded_scalar_fn, verify_multi_sigs_batch
 from .checkpoint_cache import CheckpointProofCache, ProofWindow
+from .edge_cache import EdgeProofCache, GeoReadFabric
 
 __all__ = [
     "CheckpointProofCache",
+    "EdgeProofCache",
+    "GeoReadFabric",
     "ProofWindow",
     "seeded_scalar_fn",
     "verify_multi_sigs_batch",

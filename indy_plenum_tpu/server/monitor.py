@@ -207,7 +207,8 @@ class Monitor:
             # ingress plane (admission control + device-proof reads):
             # the bounded queue's current/peak depth, the admitted/shed
             # totals the shed policy produced, and the read path's
-            # served count + wall-clock qps gauge. Absent entirely when
+            # served count + qps gauge (virtual-clock derived, so
+            # snapshots replay byte-identically). Absent entirely when
             # the run never recorded ingress metrics (admission off, no
             # reads) — existing snapshots stay byte-compatible.
             ingress = {}

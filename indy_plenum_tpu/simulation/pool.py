@@ -438,13 +438,32 @@ class SimPool:
                 capacity=trace_capacity
                 or self.config.TraceRecorderCapacity)
                 if trace else NULL_TRACE)
+        # geo plane (RegionCount > 0): node i lives in region i % R and
+        # cross-region deliveries draw from the seeded WAN pair band.
+        # Strictly opt-in — RegionCount=0 builds the exact pre-geo
+        # network (no matrix, same rng sequence, same fingerprints).
+        self.regions: Dict[str, int] = {}
+        self.region_matrix = None
+        if self.config.RegionCount > 0:
+            from .sim_network import RegionLatencyMatrix
+
+            self.regions = {f"node{i}": i % self.config.RegionCount
+                            for i in range(n_nodes)}
+            self.region_matrix = RegionLatencyMatrix(
+                self.config.RegionCount,
+                self.config.RegionLatencySeed or seed,
+                intra_band=(0.01, 0.05),
+                wan_band=(self.config.RegionWanMinLatency,
+                          self.config.RegionWanMaxLatency))
         # causal tracing plane: the network stamps net.send/net.recv
         # marks on the same recorder, so cross-node journeys carry
         # measured (delayer-inclusive) per-hop network latency
         self.network = SimNetwork(
             self.timer, seed=seed, metrics=self.metrics,
             trace=self.trace,
-            trace_receivers=self.config.TraceNetReceivers)
+            trace_receivers=self.config.TraceNetReceivers,
+            regions=self.regions or None,
+            region_matrix=self.region_matrix)
         self.validators = [f"node{i}" for i in range(n_nodes)]
         # RBFT: f+1 parallel protocol instances (0 = auto f+1); backup
         # instances get their own finalised-request queue per (node, inst)
@@ -700,16 +719,24 @@ class SimPool:
         return req
 
     def submit_request(self, seq: int,
-                       client_id: Optional[str] = None) -> Request:
+                       client_id: Optional[str] = None,
+                       region: Optional[int] = None) -> Request:
         # client_id: the ingress plane's virtual-client identity — the
         # admission controller's per-client fairness cap keys on it
         # (None = anonymous, outside any cap)
-        return self.submit_built(self.build_request(seq), client_id)
+        return self.submit_built(self.build_request(seq), client_id,
+                                 region=region)
 
     def submit_built(self, req: Request,
-                     client_id: Optional[str] = None) -> Request:
+                     client_id: Optional[str] = None,
+                     region: Optional[int] = None) -> Request:
         if self.trace.enabled:
-            self.trace.record("req.ingress", cat="req", key=(req.digest,))
+            # geo plane: the submitting client's home region rides the
+            # ingress mark into the journey table (None = unstamped —
+            # single-region dumps keep their exact bytes)
+            self.trace.record(
+                "req.ingress", cat="req", key=(req.digest,),
+                args={"region": region} if region is not None else None)
         if self.sign_requests:
             self.trustee.sign_request(req)
             if self.admission is not None:
@@ -835,18 +862,23 @@ class SimPool:
                             if self.retry is not None else 0))
 
     def make_read_service(self, name: str = "node0", mode: str = "host",
-                          capacity: int = 0):
+                          capacity: int = 0,
+                          region: Optional[int] = None):
         """A proof-serving :class:`~indy_plenum_tpu.ingress.read_service
         .ReadService` over ``name``'s committed domain ledger (requires
         real_execution): the backing rides the node's checkpoint-
         stabilized hook and, when the node runs the state-proof plane,
         replies carry the pool's window multi-signature. ``capacity``
         bounds the read queue (seeded with the POOL seed, like the write
-        side)."""
+        side); ``region`` (default: the serving node's pool region, when
+        the geo plane is armed) tags the read-journey marks so causal
+        summaries segregate read e2e per region."""
         from ..ingress.read_service import LedgerBacking, ReadService
 
         node = self.node(name)
         assert node.boot is not None, "make_read_service needs real ledgers"
+        if region is None:
+            region = self.regions.get(name)
         backing = LedgerBacking(
             node.boot.db.get_ledger(DOMAIN_LEDGER_ID),
             bus=node.internal_bus)
@@ -854,7 +886,8 @@ class SimPool:
             backing, clock=self.timer.get_current_time,
             metrics=self.metrics, trace=self.trace, mode=mode,
             proof_cache=node.proof_cache, capacity=capacity,
-            seed=self.config.IngressShedSeed or self.seed, name=name)
+            seed=self.config.IngressShedSeed or self.seed, name=name,
+            region=region)
 
     def run_for(self, seconds: float) -> None:
         self.timer.advance(seconds)

@@ -45,15 +45,64 @@ def delay_message_types(*types, frm: Optional[str] = None,
     return delayer
 
 
+class RegionLatencyMatrix:
+    """Seeded inter-region latency bands — the geo plane's WAN matrix.
+
+    Every unordered cross-region pair gets a deterministic ``(lo, hi)``
+    uniform band inside the WAN envelope: ``lo`` draws from the lower
+    half of the envelope and ``hi`` from the upper half, so ``lo < hi``
+    by construction and two matrices built from the same seed are
+    identical. Intra-region pairs (and peers with no region assignment)
+    keep the network's fast band — the matrix only REPLACES the band
+    bounds fed to the one per-delivery latency draw, so region mode
+    consumes exactly the same rng sequence length as single-region runs.
+    """
+
+    def __init__(self, n_regions: int, seed: int,
+                 intra_band: tuple, wan_band: tuple):
+        self.n_regions = n_regions
+        self.intra_band = (float(intra_band[0]), float(intra_band[1]))
+        self.wan_band = (float(wan_band[0]), float(wan_band[1]))
+        rng = random.Random(seed)
+        lo_env, hi_env = self.wan_band
+        mid = (lo_env + hi_env) / 2.0
+        self._bands: Dict[tuple, tuple] = {}
+        for a in range(n_regions):
+            for b in range(a + 1, n_regions):
+                self._bands[(a, b)] = (rng.uniform(lo_env, mid),
+                                       rng.uniform(mid, hi_env))
+
+    def band(self, a: Optional[int], b: Optional[int]) -> tuple:
+        """The (lo, hi) latency band for a delivery between regions
+        ``a`` and ``b`` (either may be None = unassigned = local)."""
+        if a is None or b is None or a == b:
+            return self.intra_band
+        key = (a, b) if a < b else (b, a)
+        return self._bands[key]
+
+    def as_dict(self) -> Dict[str, list]:
+        """The pair bands as a JSON-able record (bench/gate reports)."""
+        return {"%d-%d" % (a, b): [round(lo, 6), round(hi, 6)]
+                for (a, b), (lo, hi) in sorted(self._bands.items())}
+
+
 class SimNetwork:
     def __init__(self, timer: MockTimer, seed: int = 0,
                  min_latency: float = 0.01, max_latency: float = 0.05,
                  metrics: Optional[MetricsCollector] = None,
-                 trace=None, trace_receivers: int = 0):
+                 trace=None, trace_receivers: int = 0,
+                 regions: Optional[Dict[str, int]] = None,
+                 region_matrix: Optional[RegionLatencyMatrix] = None):
         self._timer = timer
         self._rng = random.Random(seed)
         self._min_latency = min_latency
         self._max_latency = max_latency
+        # geo plane: per-peer region assignment + the pair-band matrix.
+        # Both default off — the default path draws from the single
+        # (min, max) band exactly as before, bit-identical per seed.
+        self._regions: Dict[str, int] = dict(regions) if regions else {}
+        self._region_matrix = region_matrix
+        self.cross_region = 0
         self._peers: Dict[str, ExternalBus] = {}
         self._peer_order: list[str] = []
         self._delayers: list[Delayer] = []
@@ -113,12 +162,25 @@ class SimNetwork:
     def reset_delays(self) -> None:
         self._delayers.clear()
 
+    def region_of(self, name: str) -> Optional[int]:
+        return self._regions.get(name)
+
+    def assign_region(self, name: str, region: int) -> None:
+        """Place a peer (or a client endpoint) in a region after
+        construction — the geo fabric registers client homes here."""
+        self._regions[name] = region
+
     def counters(self) -> Dict[str, Any]:
         """Delivery accounting snapshot (chaos report / diagnostics)."""
-        return {"sent": self.sent, "dropped": self.dropped,
-                "duplicated": self.duplicated,
-                "sent_by_type": dict(self.sent_by_type),
-                "dropped_by_type": dict(self.dropped_by_type)}
+        out = {"sent": self.sent, "dropped": self.dropped,
+               "duplicated": self.duplicated,
+               "sent_by_type": dict(self.sent_by_type),
+               "dropped_by_type": dict(self.dropped_by_type)}
+        if self._region_matrix is not None:
+            # absent entirely on single-region runs: pre-geo network
+            # blocks stay byte-compatible
+            out["cross_region"] = self.cross_region
+        return out
 
     # --- delivery -------------------------------------------------------
 
@@ -168,7 +230,17 @@ class SimNetwork:
         if not self._peers[to].is_connected(frm):
             self._count_drop(msg, frm, to)
             return
-        latency = self._rng.uniform(self._min_latency, self._max_latency)
+        # ONE latency draw per delivery, region mode or not: the geo
+        # matrix only swaps the band bounds, so single-region runs keep
+        # their exact historical rng sequence
+        lo, hi = self._min_latency, self._max_latency
+        is_wan = False
+        if self._region_matrix is not None:
+            band = self._region_matrix.band(self._regions.get(frm),
+                                            self._regions.get(to))
+            is_wan = band is not self._region_matrix.intra_band
+            lo, hi = band
+        latency = self._rng.uniform(lo, hi)
         offsets = [0.0]  # one entry per copy that will be delivered
         for delayer in list(self._delayers):
             extra = delayer(msg, frm, to)
@@ -183,6 +255,8 @@ class SimNetwork:
             offsets = [o + extra for o in offsets]
         self.sent += len(offsets)
         self.duplicated += len(offsets) - 1
+        if is_wan:
+            self.cross_region += len(offsets)
         self.sent_by_type[type(msg).__name__] += len(offsets)
         if self._metrics is not None:
             self._metrics.add_event(MetricsName.SIM_NET_DELIVERED,

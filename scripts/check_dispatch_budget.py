@@ -115,6 +115,19 @@ multi-hour horizon) holds a flat bounded-structure memory high-water,
 <5% ordered-throughput drift first-vs-last simulated hour
 (``--state-drift-tolerance``), byte-identical across two same-seed runs.
 
+Geo gate (PR 18): unless ``--no-geo-gate``, the planet-scale read
+fabric proves itself on a 3-region pool — the edge arm serves
+>= ``--geo-hit-floor`` (default 90%) of a region-spread read storm
+from region-local edge proof caches at intra-band p99 while the
+same-seed no-edge arm pays the WAN band for non-home regions, the edge
+serve path performs ZERO pairing checks (clients amortize one full
+multi-sig verify per trusted window and bind every later reply to it
+offline), every reply in both arms passes client verification,
+ordered/journey/shed fingerprints stay bit-identical between arms (the
+fabric models latency on a dedicated seeded RNG — it never touches the
+pool's RNG or timer), and two same-seed edge runs produce
+byte-identical records.
+
 Running one gate: ``--only latency`` (or ``--only trace,latency``)
 replaces stacking nine ``--no-*-gate`` flags; ``--list-gates`` prints
 the names.
@@ -1390,6 +1403,133 @@ def state_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def geo_gate(args) -> "tuple[dict, list]":
+    """Planet-scale read fabric gate: a 3-region seeded pool serves a
+    region-spread read storm twice on the same seed — once through
+    region-local edge proof caches, once with every read paying the WAN
+    trip to the origin validator. Passes when (1) the edge arm serves
+    >= ``--geo-hit-floor`` of reads region-locally at intra-band p99
+    while the no-edge arm's non-home regions pay the WAN band; (2) the
+    edge serve path performs ZERO pairing checks (clients amortize one
+    full verify per trusted window); (3) every reply in BOTH arms
+    passes offline client verification; (4) ordered/journey/shed
+    fingerprints are bit-identical between arms (the fabric never
+    touches the pool's RNG or timer); (5) two same-seed edge runs
+    produce byte-identical records."""
+    from indy_plenum_tpu.observability.causal import journey_summary
+    from indy_plenum_tpu.proofs.edge_cache import (
+        EdgeProofCache,
+        GeoReadFabric,
+    )
+
+    def run(use_edges: bool) -> dict:
+        config = getConfig({
+            "CHK_FREQ": 5, "LOG_SIZE": 15,
+            "Max3PCBatchSize": 1, "Max3PCBatchWait": 0.05,
+            "RegionCount": 3,
+        })
+        pool = SimPool(4, seed=args.seed, config=config,
+                       real_execution=True, bls=True, trace=True)
+        for i in range(8):
+            pool.submit_request(i, region=i % 3)
+        deadline = time.monotonic() + 240
+        while (min(len(nd.ordered_digests) for nd in pool.nodes) < 8
+               or pool.nodes[0].proof_cache.current() is None) \
+                and time.monotonic() < deadline:
+            pool.run_for(0.5)
+        assert pool.honest_nodes_agree()
+        assert pool.nodes[0].proof_cache.current() is not None, \
+            "no proof window stabilized for the edge tier"
+        origin = pool.make_read_service("node0", mode="host")
+        entry = origin.proof_cache.current()
+        pool_keys = {n: pk
+                     for n, (kp, pk, pop) in pool.bls_keys.items()}
+        edges = {}
+        if use_edges:
+            for i in range(entry.tree_size):
+                origin.submit(i)
+            replies = origin.drain()
+            edges = {r: EdgeProofCache(
+                region=r, clock=pool.timer.get_current_time)
+                for r in range(3)}
+            for edge in edges.values():
+                edge.replicate(entry.window, replies)
+        fabric = GeoReadFabric(
+            origin, pool.region_matrix, pool_keys, min_participants=3,
+            n_regions=3, origin_region=0, edges=edges, seed=args.seed,
+            clock=pool.timer.get_current_time)
+        for wave in range(3):
+            for client in range(60):
+                fabric.submit(client,
+                              (7 * client + wave) % entry.tree_size)
+            served = fabric.drain()
+            assert len(served) == 60, (wave, len(served))
+            pool.run_for(1.0)
+        counters = fabric.counters()
+        js = journey_summary(pool.trace.events())
+        # deterministic by construction: virtual clock + the fabric's
+        # dedicated seeded RNG — no wall fields, so the whole record is
+        # byte-comparable across same-seed runs
+        return {
+            "edges": bool(use_edges),
+            "fabric": counters,
+            "ordered_hash": pool.ordered_hash(),
+            "journey_hash": js["journey_hash"],
+            "shed_hash": origin.shed_hash(),
+        }
+
+    serving = run(use_edges=True)
+    replay = run(use_edges=True)
+    plain = run(use_edges=False)
+    failures = []
+    fb = serving["fabric"]
+    if fb["edge_hit_rate"] < args.geo_hit_floor:
+        failures.append(
+            f"edge hit rate {fb['edge_hit_rate']} below floor "
+            f"{args.geo_hit_floor} (reads leaking to the origin)")
+    if fb["edge_serve_pairings"] != 0:
+        failures.append(
+            f"edge serve path performed {fb['edge_serve_pairings']} "
+            "pairing checks (must be lookups — zero pairings)")
+    if fb["verify_failures"] or plain["fabric"]["verify_failures"]:
+        failures.append("replies failed offline client verification")
+    intra_hi = 0.05  # the pool's intra-region band ceiling
+    wan_floor = getConfig().RegionWanMinLatency
+    for region, block in fb["regions"].items():
+        if block["latency_p99"] > intra_hi:
+            failures.append(
+                f"edge arm region {region} read p99 "
+                f"{block['latency_p99']} above the intra band "
+                f"{intra_hi} (edge tier not region-local)")
+    for region in ("1", "2"):
+        p99 = plain["fabric"]["regions"][region]["latency_p99"]
+        if p99 < wan_floor:
+            failures.append(
+                f"no-edge arm region {region} read p99 {p99} under the "
+                f"WAN floor {wan_floor} (baseline not paying the WAN)")
+    for key in ("ordered_hash", "journey_hash", "shed_hash"):
+        if serving[key] != plain[key]:
+            failures.append(
+                f"{key} diverged between the edge and no-edge arms "
+                "(the read fabric perturbed the write planes)")
+    deterministic = (json.dumps(serving, sort_keys=True)
+                     == json.dumps(replay, sort_keys=True))
+    if not deterministic:
+        failures.append("two same-seed edge runs were not "
+                        "byte-identical")
+    record = {
+        "edge": serving,
+        "no_edge": plain,
+        "hit_floor": args.geo_hit_floor,
+        "deterministic": deterministic,
+        "wan_over_edge_p99": round(
+            max(plain["fabric"]["regions"][r]["latency_p99"]
+                for r in ("1", "2"))
+            / max(b["latency_p99"] for b in fb["regions"].values()), 2),
+    }
+    return record, failures
+
+
 # gate registry (--list-gates / --only): name -> (argparse dest of the
 # skip flag, one-line description). The core dispatch-budget measurement
 # always runs — it is the baseline every budget compares against.
@@ -1421,6 +1561,10 @@ GATES = {
               "sequential/host/auto arms, >=3x hashes/commit reduction "
               "at delta=256 on 100k keys, flat+deterministic "
               "virtual-time soak"),
+    "geo": ("no_geo_gate",
+            "planet-scale read fabric: >=90% edge-local reads at intra "
+            "p99 vs same-seed WAN baseline, zero serve-path pairings, "
+            "bit-identical write fingerprints, deterministic replay"),
 }
 
 
@@ -1512,6 +1656,15 @@ def main() -> int:
     ap.add_argument("--state-drift-tolerance", type=float, default=0.05,
                     help="max first-vs-last simulated-hour ordered-"
                          "throughput drift the soak arm accepts")
+    ap.add_argument("--no-geo-gate", action="store_true",
+                    help="skip the planet-scale read fabric gate "
+                         "(edge hit-rate floor at intra-band p99 vs "
+                         "the same-seed WAN baseline, zero serve-path "
+                         "pairings, bit-identical write fingerprints "
+                         "between arms, byte-identical replay)")
+    ap.add_argument("--geo-hit-floor", type=float, default=0.90,
+                    help="min fraction of storm reads the edge arm "
+                         "must serve from region-local edge caches")
     ap.add_argument("--only", default=None, metavar="GATE[,GATE]",
                     help="run ONLY the named gate(s) — e.g. '--only "
                          "latency' instead of stacking nine --no-*-gate "
@@ -1666,6 +1819,10 @@ def main() -> int:
     if not args.no_state_gate:
         record, failures = state_gate(args)
         result["state_gate"] = record
+        over.extend(failures)
+    if not args.no_geo_gate:
+        record, failures = geo_gate(args)
+        result["geo_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

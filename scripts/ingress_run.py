@@ -6,9 +6,9 @@ device-proof :class:`~indy_plenum_tpu.ingress.read_service.ReadService`,
 and emits ONE machine-readable JSON line: arrivals/admitted/shed, the
 shed-set fingerprint, sustained ordered/sim-second, p50/p99
 ``req.ingress -> req.finalised`` latency from the flight-recorder spans,
-read qps, ``ordered_hash`` and ``trace_hash``. Same seed => byte-identical
-record fields (the wall-clock ones excepted) — replay a saturation
-incident exactly.
+read qps (virtual-clock derived), ``ordered_hash`` and ``trace_hash``.
+Same seed => byte-identical record fields (only ``wall_s`` is wall time)
+— replay a saturation incident exactly.
 
 Workload profiles + closed-loop retry (overload robustness plane):
 ``--profile diurnal|flash`` modulates the arrival rate (day curve /
@@ -138,8 +138,7 @@ def main() -> int:
     for i in range(63):
         reads.submit(i)
     reads.drain()
-    reads.served_total = reads.verified_total = 0
-    reads.serve_wall_s = 0.0
+    reads.reset_serve_meters()
 
     seq = [0]
 

@@ -27,7 +27,9 @@ the causal plane's cross-node joins work either way. Views:
 - **--journeys**: the causal journey table (observability.causal) —
   per-request end-to-end latency ACROSS NODES with network / queue /
   compute / device attribution, completeness, and the byte-stable
-  ``journey_hash``.
+  ``journey_hash``. Geo dumps add a home-region column per journey and
+  a per-region write/read e2e rollup; ``--region R`` (like ``--lane``)
+  restricts the table to one region.
 - **--journey DIGEST** (prefix ok): one request's full cross-node path —
   every per-node lifecycle mark with its deterministic span id, per-hop
   attribution, and the per-wave network latency samples behind it.
@@ -77,8 +79,9 @@ def _flight_events(events) -> list:
 def _print_journey(detail: dict) -> None:
     j = detail["journey"]
     lane = f" lane={j['lane']}" if "lane" in j else ""
+    region = f" region={j['region']}" if "region" in j else ""
     print(f"journey {j['digest'][:16]}… trace_id={j['trace_id']} "
-          f"class={j['class']}{lane} batch=(v{j['batch'][0]} "
+          f"class={j['class']}{lane}{region} batch=(v{j['batch'][0]} "
           f"s{j['batch'][1]} {str(j['batch'][2])[:12]}…)")
     print(f"  e2e={j['e2e']} complete={j['complete']} "
           f"attribution={j['attribution']}"
@@ -135,6 +138,20 @@ def _print_journey_table(record: dict) -> None:
         print(f"  lanes: {lanes['count']} "
               f"(barrier hop on {lanes['with_barrier_hop']}"
               f"/{lanes['with_lane']})  {per}")
+    regions = js.get("regions")
+    if regions:
+        print(f"  regions: {regions['count']} "
+              f"(tagged {regions['with_region']}/{js['count']} writes)")
+        per_w = regions.get("journeys_per_region") or {}
+        e2e_w_r = regions.get("e2e_per_region") or {}
+        for r in sorted(per_w, key=int):
+            st = e2e_w_r.get(r) or {}
+            print(f"    R{r} write: n={per_w[r]} p50={st.get('p50')} "
+                  f"p99={st.get('p99')}")
+        for r, st in sorted((regions.get("read_e2e_per_region")
+                             or {}).items(), key=lambda kv: int(kv[0])):
+            print(f"    R{r} read:  n={st['count']} p50={st['p50']} "
+                  f"p99={st['p99']}")
     fw = js.get("fault_window")
     if fw:
         print(f"  fault windows: {fw['windows']} — "
@@ -146,10 +163,12 @@ def _print_journey_table(record: dict) -> None:
         catchup = (" catchup=" + ",".join(j["catchup"])
                    if j.get("catchup") else "")
         lane = f"lane={j['lane']} " if "lane" in j else ""
+        region = f"region={j['region']} " if "region" in j else ""
         # closed-loop retry: how many re-offers this request took (its
         # hops then carry the `retry` hop's backoff wait)
         retries = f"retries={j['retries']} " if j.get("retries") else ""
-        print(f"  {j['digest'][:16]}… {lane}{retries}e2e={j['e2e']} "
+        print(f"  {j['digest'][:16]}… {lane}{region}{retries}"
+              f"e2e={j['e2e']} "
               f"batch=v{j['batch'][0]}s{j['batch'][1]} "
               f"net={j['attribution']['network']} "
               f"queue={j['attribution']['queue']} "
@@ -181,6 +200,11 @@ def main() -> int:
                     help="restrict the --journeys table to one ordering "
                          "lane (laned dumps tag every journey with its "
                          "lane; the summary rollup stays pool-wide)")
+    ap.add_argument("--region", type=int, default=None, metavar="R",
+                    help="restrict the --journeys table to one home "
+                         "region (geo dumps tag every journey with the "
+                         "submitting client's region; the summary "
+                         "rollup stays pool-wide)")
     ap.add_argument("--chrome", metavar="OUT",
                     help="write Chrome trace-event JSON (Perfetto)")
     ap.add_argument("--node", default=None,
@@ -228,6 +252,9 @@ def main() -> int:
             table = built["journeys"]
             if args.lane is not None:
                 table = [j for j in table if j.get("lane") == args.lane]
+            if args.region is not None:
+                table = [j for j in table
+                         if j.get("region") == args.region]
             record["journey_table"] = table
     if not view_selected:
         record["flight_events"] = _flight_events(events)

@@ -1,0 +1,455 @@
+"""Planet-scale read fabric: regional latency realism + edge proof tier.
+
+The contracts under test (README "Planet-scale read fabric"):
+
+- ``RegionLatencyMatrix`` is seeded-deterministic, symmetric, and every
+  cross-region band sits inside the WAN envelope with ``lo < hi``;
+  intra-region (and unassigned) pairs keep the fast band;
+- region mode is STRICTLY opt-in: ``RegionCount=0`` builds no matrix and
+  reports no ``cross_region`` counter (pre-geo network blocks stay
+  byte-compatible); ``RegionCount=3`` places node i in region i % 3,
+  crosses regions, and still orders deterministically per seed;
+- ``EdgeProofCache`` is an UNTRUSTED bounded replica: ``replicate()``
+  refuses cross-window smear, ``get()`` serves the newest held window by
+  dict lookup (no pairings), entries evict LRU at ``max_entries``,
+  windows retire FIFO at ``keep_windows`` and on the master instance's
+  ``CheckpointStabilized`` seal; ``poison()`` tampers served replies
+  deterministically and EVERY tampered reply fails offline verification
+  — verification, not the cache, is the security boundary;
+- ``GeoReadFabric`` verifies every reply offline, amortizing ONE
+  pairing-bearing ``verify_proved_read`` per distinct signed window
+  (``verify_read_binding`` — pairing-free — after), and falls back to
+  the origin on miss / stale / verification failure;
+- freshness at the edge: a window EXACTLY at ``max_age`` is still fresh
+  (strict ``>``, matching ``verify_pool_multi_sig``), a client clock
+  BEHIND the window timestamp never reads as stale, and a window the
+  origin already evicted still serves (and verifies) from an edge that
+  holds it — until the freshness bound retires it to the origin.
+"""
+import hashlib
+
+from indy_plenum_tpu.client.state_proof import (
+    verify_proved_read,
+    verify_read_binding,
+)
+from indy_plenum_tpu.common.event_bus import InternalBus
+from indy_plenum_tpu.common.messages.internal_messages import (
+    CheckpointStabilized,
+)
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.crypto.bls.bls_crypto import (
+    PAIRINGS,
+    BlsCryptoSigner,
+    BlsCryptoVerifier,
+    BlsKeyPair,
+    MultiSignature,
+    MultiSignatureValue,
+)
+from indy_plenum_tpu.ingress.read_service import (
+    ReadService,
+    StaticCorpusBacking,
+)
+from indy_plenum_tpu.observability.causal import journey_summary
+from indy_plenum_tpu.proofs import CheckpointProofCache, ProofWindow
+from indy_plenum_tpu.proofs.edge_cache import (
+    EdgeProofCache,
+    GeoReadFabric,
+)
+from indy_plenum_tpu.simulation.pool import SimPool
+from indy_plenum_tpu.simulation.sim_network import RegionLatencyMatrix
+from indy_plenum_tpu.utils.base58 import b58encode
+
+import pytest
+
+TS0 = 1_700_000_000
+
+
+def _signed_window(backing, signers, names, window=(0, 100), ts=TS0):
+    value = MultiSignatureValue(
+        ledger_id=1, state_root_hash="geo-state-root",
+        pool_state_root_hash="",
+        txn_root_hash=b58encode(backing.root), timestamp=ts)
+    msg = value.serialize()
+    agg = BlsCryptoVerifier.aggregate_sigs([s.sign(msg) for s in signers])
+    ms = MultiSignature(signature=agg, participants=list(names),
+                        value=value)
+    return ProofWindow(
+        window=tuple(window), tree_size=backing.tree_size,
+        root=backing.root, state_root_b58="geo-state-root",
+        multi_sig=ms, multi_sig_dict=ms.as_dict(), captured_at=0.0)
+
+
+class _Corpus:
+    """A synthetic proof-serving origin: static corpus, 4 BLS signers,
+    one installed signed window, a settable virtual clock."""
+
+    def __init__(self, n=64, seed=11, keep=2, ts=TS0):
+        self.backing = StaticCorpusBacking(n, seed=seed)
+        kps = [BlsKeyPair(hashlib.sha256(b"geo-%d" % i).digest())
+               for i in range(4)]
+        self.signers = [BlsCryptoSigner(kp) for kp in kps]
+        self.names = ["node%d" % i for i in range(4)]
+        self.keys = dict(zip(self.names, (kp.pk_b58 for kp in kps)))
+        self.clockval = [float(ts) + 10.0]
+        self.cache = CheckpointProofCache(
+            bls_replica=None,
+            root_provider=lambda: (self.backing.tree_size,
+                                   self.backing.root),
+            state_root_provider=lambda: "geo-state-root", keep=keep)
+        self.cache.install(_signed_window(
+            self.backing, self.signers, self.names, ts=ts))
+        self.origin = ReadService(
+            self.backing, mode="host", proof_cache=self.cache,
+            clock=lambda: self.clockval[0])
+
+    def replies(self, n=None):
+        for i in range(n if n is not None else self.backing.tree_size):
+            self.origin.submit(i)
+        return self.origin.drain()
+
+    def fabric(self, edges, seed=5, max_age=300.0, n_regions=3):
+        return GeoReadFabric(
+            self.origin, RegionLatencyMatrix(
+                n_regions, seed=7, intra_band=(0.01, 0.05),
+                wan_band=(0.08, 0.25)),
+            self.keys, min_participants=3, n_regions=n_regions,
+            origin_region=0, edges=edges, seed=seed,
+            clock=lambda: self.clockval[0], max_age=max_age)
+
+
+# --- regional latency matrix ------------------------------------------
+
+
+def test_region_matrix_deterministic_banded_symmetric():
+    a = RegionLatencyMatrix(4, seed=13, intra_band=(0.01, 0.05),
+                            wan_band=(0.08, 0.25))
+    b = RegionLatencyMatrix(4, seed=13, intra_band=(0.01, 0.05),
+                            wan_band=(0.08, 0.25))
+    assert a.as_dict() == b.as_dict()
+    assert a.as_dict() != RegionLatencyMatrix(
+        4, seed=14, intra_band=(0.01, 0.05),
+        wan_band=(0.08, 0.25)).as_dict()
+    for (lo, hi) in a.as_dict().values():
+        assert 0.08 <= lo < hi <= 0.25
+    assert a.band(1, 3) == a.band(3, 1)
+    # intra / unassigned pairs keep the fast band (identity matters:
+    # the fabric distinguishes WAN by band object, values may collide)
+    assert a.band(2, 2) is a.intra_band
+    assert a.band(None, 1) is a.intra_band
+
+
+def test_pool_region_wiring_and_opt_in():
+    config = getConfig({"Max3PCBatchSize": 2, "Max3PCBatchWait": 0.05,
+                        "RegionCount": 3})
+    pool = SimPool(4, seed=9, config=config)
+    assert pool.regions == {"node0": 0, "node1": 1, "node2": 2,
+                            "node3": 0}
+    assert pool.network.region_of("node1") == 1
+    for i in range(8):
+        pool.submit_request(i, region=i % 3)
+    pool.run_for(10)
+    assert min(len(nd.ordered_digests) for nd in pool.nodes) >= 8
+    assert pool.honest_nodes_agree()
+    assert pool.network.counters()["cross_region"] > 0
+    # deterministic per seed with the matrix armed
+    pool2 = SimPool(4, seed=9, config=config)
+    for i in range(8):
+        pool2.submit_request(i, region=i % 3)
+    pool2.run_for(10)
+    assert pool2.ordered_hash() == pool.ordered_hash()
+    assert pool2.region_matrix.as_dict() == pool.region_matrix.as_dict()
+
+    # strictly opt-in: RegionCount=0 builds no matrix and the network
+    # block carries no cross_region key (pre-geo reports byte-compatible)
+    off = SimPool(4, seed=9, config=getConfig(
+        {"Max3PCBatchSize": 2, "Max3PCBatchWait": 0.05}))
+    assert off.region_matrix is None and off.regions == {}
+    for i in range(8):
+        off.submit_request(i)
+    off.run_for(10)
+    assert "cross_region" not in off.network.counters()
+
+
+# --- edge proof cache --------------------------------------------------
+
+
+def test_edge_replicate_serve_and_window_smear():
+    c = _Corpus()
+    replies = c.replies()
+    edge = EdgeProofCache(region=1, keep_windows=2, max_entries=4096)
+    assert edge.replicate((0, 100), replies) == 64
+    # cross-window smear refused: same replies against another window
+    assert edge.replicate((101, 200), replies) == 0
+    reply = edge.get(5)
+    assert reply is replies[5]
+    # folding: index beyond tree_size lands on index % tree_size
+    assert edge.get(64 + 5) is replies[5]
+    assert edge.get(10_000) is not None
+    ctr = edge.counters()
+    assert ctr["hits"] == 3 and ctr["misses"] == 0
+    assert ctr["hit_rate"] == 1.0
+
+
+def test_edge_store_requires_window_and_multisig():
+    c = _Corpus()
+    replies = c.replies(4)
+    edge = EdgeProofCache(region=0, keep_windows=2, max_entries=64)
+    assert edge.store(replies[0])
+    from dataclasses import replace
+
+    assert not edge.store(replace(replies[1], window=None))
+    assert not edge.store(replace(replies[2], multi_sig=None))
+    assert edge.counters()["stored"] == 1
+
+
+def test_edge_lru_and_window_bounds():
+    c = _Corpus()
+    replies = c.replies()
+    small = EdgeProofCache(region=0, keep_windows=2, max_entries=16)
+    small.replicate((0, 100), replies)
+    ctr = small.counters()
+    assert ctr["entries"] == 16 and ctr["entries_evicted"] == 48
+    # the survivors are the LAST 16 replicated (LRU evicts oldest)
+    assert small.get(63) is not None
+    assert small.get(0) is None
+
+    windows = EdgeProofCache(region=0, keep_windows=2, max_entries=4096)
+    for k in range(4):
+        windows.replicate((k * 100, k * 100 + 99), replies[:1])
+    ctr = windows.counters()
+    assert ctr["windows_held"] == 2 and ctr["windows_evicted"] == 2
+
+
+def test_edge_invalidation_rides_master_seals_only():
+    c = _Corpus()
+    replies = c.replies()
+    bus = InternalBus()
+    edge = EdgeProofCache(region=2, keep_windows=2, max_entries=4096,
+                          bus=bus)
+    edge.replicate((0, 100), replies[:8])
+    edge.replicate((101, 200), replies[:0])  # placeholder second window
+    assert edge.counters()["windows_held"] == 2
+    # backup-instance seals are ignored (same discipline as the origin's
+    # LedgerBacking / CheckpointProofCache hooks)
+    bus.send(CheckpointStabilized(inst_id=1, last_stable_3pc=(0, 10)))
+    assert edge.counters()["invalidations"] == 0
+    assert edge.counters()["windows_held"] == 2
+    # a master seal retires the OLDEST held window to make room
+    bus.send(CheckpointStabilized(inst_id=0, last_stable_3pc=(0, 10)))
+    ctr = edge.counters()
+    assert ctr["invalidations"] == 1 and ctr["windows_held"] == 1
+    assert edge.get(0) is None or edge.get(0).window != (0, 100)
+
+
+def test_edge_bounds_must_be_positive():
+    for kw in ({"keep_windows": 0}, {"max_entries": -1}):
+        with pytest.raises(ValueError):
+            EdgeProofCache(region=0, **{"keep_windows": 2,
+                                        "max_entries": 64, **kw})
+
+
+def test_poisoned_edge_every_tamper_kind_fails_verification():
+    c = _Corpus()
+    replies = c.replies()
+    edge = EdgeProofCache(region=1, keep_windows=2,
+                          max_entries=4096).poison(seed=3)
+    edge.replicate((0, 100), replies)
+    kinds = set()
+    for i in range(48):
+        tampered = edge.get(i)
+        clean = replies[i]
+        assert tampered is not clean
+        if tampered.leaf != clean.leaf:
+            kinds.add("leaf")
+        elif tampered.root != clean.root:
+            kinds.add("root")
+        else:
+            assert tampered.multi_sig["signature"] \
+                != clean.multi_sig["signature"]
+            kinds.add("signature")
+        assert not verify_proved_read(tampered, c.keys,
+                                      min_participants=3)
+        assert verify_proved_read(clean, c.keys, min_participants=3)
+    # 48 serves deterministically exercise all three tamper kinds
+    assert kinds == {"leaf", "root", "signature"}
+    assert edge.counters()["tampered"] == 48
+    # stored entries stay CLEAN — tampering is a per-serve copy, so
+    # disarming the poison serves the pristine reply again
+    edge._poison_rng = None
+    assert edge.get(0) is replies[0]
+
+
+def test_poison_is_deterministic_per_seed():
+    c = _Corpus()
+    replies = c.replies()
+
+    def serve(seed):
+        edge = EdgeProofCache(region=1, keep_windows=2,
+                              max_entries=4096).poison(seed=seed)
+        edge.replicate((0, 100), replies)
+        return [(edge.get(i).leaf, edge.get(i).root) for i in range(8)]
+
+    assert serve(3) == serve(3)
+    assert serve(3) != serve(4)
+
+
+# --- verify_read_binding (the pairing-free amortized check) ------------
+
+
+def test_read_binding_no_pairings_and_catches_tamper():
+    c = _Corpus()
+    replies = c.replies(4)
+    before = PAIRINGS.checks
+    assert verify_read_binding(replies[0])
+    assert PAIRINGS.checks == before  # pairing-free by construction
+    from dataclasses import replace
+
+    bad_leaf = replace(replies[1],
+                       leaf=b"\x00" + bytes(replies[1].leaf[1:]))
+    assert not verify_read_binding(bad_leaf)
+    bad_root = replace(replies[2],
+                       root=b"\x00" + bytes(replies[2].root[1:]))
+    assert not verify_read_binding(bad_root)
+    assert not verify_read_binding(replace(replies[3], multi_sig=None))
+
+
+# --- geo read fabric ---------------------------------------------------
+
+
+def test_fabric_amortizes_one_pairing_per_window():
+    c = _Corpus()
+    replies = c.replies()
+    edges = {r: EdgeProofCache(region=r, keep_windows=2,
+                               max_entries=4096) for r in range(3)}
+    for e in edges.values():
+        e.replicate((0, 100), replies)
+    fabric = c.fabric(edges)
+    before = PAIRINGS.checks
+    for client in range(150):
+        fabric.submit(client, client * 7)
+    out = fabric.drain()
+    assert len(out) == 150
+    ctr = fabric.counters()
+    assert ctr["edge_hit_rate"] == 1.0
+    assert ctr["edge_serve_pairings"] == 0
+    # ONE full verify for the whole storm — every later reply pays only
+    # the pairing-free binding check
+    assert PAIRINGS.checks - before == 1
+    for block in ctr["regions"].values():
+        assert block["latency_p99"] <= 0.05  # intra band
+
+
+def test_fabric_no_edges_pays_wan_to_origin():
+    c = _Corpus()
+    fabric = c.fabric(edges=None)
+    for client in range(90):
+        fabric.submit(client, client)
+    out = fabric.drain()
+    assert len(out) == 90
+    ctr = fabric.counters()
+    assert ctr["edge_served"] == 0 and ctr["origin_served"] == 90
+    assert ctr["regions"]["1"]["latency_p99"] >= 0.08  # WAN floor
+    assert ctr["regions"]["0"]["latency_p99"] <= 0.05  # home stays intra
+
+
+def test_fabric_catches_poison_and_answers_via_origin():
+    c = _Corpus()
+    replies = c.replies()
+    poisoned = EdgeProofCache(region=1, keep_windows=2,
+                              max_entries=4096).poison(seed=3)
+    poisoned.replicate((0, 100), replies)
+    fabric = c.fabric({1: poisoned})
+    for k in range(40):
+        fabric.submit(3 * k + 1, k)  # every client homes in region 1
+    out = fabric.drain()
+    ctr = fabric.counters()
+    assert poisoned.tampered_total == 40
+    assert ctr["verify_caught"] == 40
+    assert ctr["origin_served"] == 40 and ctr["edge_served"] == 0
+    assert len(out) == 40  # every read still answered, via fallback
+    assert ctr["verify_failures"] == 0
+
+
+# --- freshness at the edge boundary ------------------------------------
+
+
+def test_exactly_at_max_age_is_still_fresh():
+    c = _Corpus()
+    reply = c.replies(1)[0]
+    ts = reply.multi_sig["value"]["timestamp"]
+    # strict >: the boundary instant passes, one tick past fails
+    assert verify_proved_read(reply, c.keys, min_participants=3,
+                              now=ts + 300.0, max_age=300.0)
+    assert not verify_proved_read(reply, c.keys, min_participants=3,
+                                  now=ts + 300.001, max_age=300.0)
+    fabric = c.fabric(edges=None, max_age=300.0)
+    assert not fabric._stale(reply, ts + 300.0)
+    assert fabric._stale(reply, ts + 300.001)
+
+
+def test_client_clock_skew_behind_window_is_not_stale():
+    c = _Corpus()
+    replies = c.replies()
+    edge = EdgeProofCache(region=1, keep_windows=2, max_entries=4096)
+    edge.replicate((0, 100), replies)
+    fabric = c.fabric({1: edge}, max_age=300.0)
+    ts = replies[0].multi_sig["value"]["timestamp"]
+    # a client whose clock runs BEHIND the pool's window timestamp sees
+    # a negative age — never stale, and verification still passes
+    c.clockval[0] = ts - 120.0
+    fabric.submit(1, 0)
+    out = fabric.drain()
+    ctr = fabric.counters()
+    assert len(out) == 1 and ctr["edge_served"] == 1
+    assert ctr["stale_fallbacks"] == 0 and ctr["verify_caught"] == 0
+
+
+def test_sealed_then_evicted_window_survives_at_the_edge():
+    # keep=1 at the origin: installing window 2 EVICTS window 1 there
+    c = _Corpus(keep=1)
+    w1_replies = c.replies()
+    edge = EdgeProofCache(region=1, keep_windows=2, max_entries=4096)
+    edge.replicate((0, 100), w1_replies)
+    c.cache.install(_signed_window(c.backing, c.signers, c.names,
+                                   window=(101, 200), ts=TS0 + 200))
+    assert c.cache.get((0, 100)) is None  # origin no longer holds w1
+    fabric = c.fabric({1: edge}, max_age=300.0)
+    fabric.submit(1, 7)
+    out = fabric.drain()
+    # the origin moved on, but the edge still serves window 1 and the
+    # client still proves it offline — the proof is self-certifying
+    assert len(out) == 1 and out[0].window == (0, 100)
+    assert fabric.counters()["edge_served"] == 1
+
+    # ... until the freshness bound retires it: past w1's max_age the
+    # edge entry goes stale and the origin answers from window 2
+    c.clockval[0] = TS0 + 301.0
+    fabric.submit(1, 7)
+    out = fabric.drain()
+    ctr = fabric.counters()
+    assert len(out) == 1 and out[0].window == (101, 200)
+    assert ctr["stale_fallbacks"] == 1 and ctr["origin_served"] == 1
+    assert ctr["verify_failures"] == 0
+
+
+# --- causal regions rollup ---------------------------------------------
+
+
+def test_journey_summary_regions_block_is_opt_in():
+    config = getConfig({"Max3PCBatchSize": 2, "Max3PCBatchWait": 0.05,
+                        "RegionCount": 3})
+    pool = SimPool(4, seed=21, config=config, trace=True)
+    for i in range(6):
+        pool.submit_request(i, region=i % 3)
+    pool.run_for(10)
+    js = journey_summary(pool.trace.events())
+    regions = js["regions"]
+    assert regions["journeys_per_region"] == {"0": 2, "1": 2, "2": 2}
+    assert set(regions["e2e_per_region"]) == {"0", "1", "2"}
+
+    plain = SimPool(4, seed=21, config=getConfig(
+        {"Max3PCBatchSize": 2, "Max3PCBatchWait": 0.05}), trace=True)
+    for i in range(6):
+        plain.submit_request(i)
+    plain.run_for(10)
+    assert "regions" not in journey_summary(plain.trace.events())

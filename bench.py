@@ -154,7 +154,8 @@ def bench_ed25519() -> dict:
 def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
                    metric: str, note: str,
                    host_accounting: bool = False, mesh=None,
-                   host_eval: bool = False) -> dict:
+                   host_eval: bool = False,
+                   resident_depth: int = 0) -> dict:
     """Ordered txns/sec with the device quorum plane as sole authority
     (no host shadow tallies), tick-batched flushes. ``num_instances`` > 1
     runs the full RBFT instance axis — backups' tallies ride the same
@@ -188,6 +189,10 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         # 4 validators only, keeping per-wave latency stats
         # representative without flooding the ring
         "TraceNetReceivers": 4,
+        # multi-tick device residency (PR 19): > 1 keeps votes resident
+        # in device-side ring slots across this many ticks before one
+        # fused consume — same ordering, fewer host round-trips
+        "ResidentTickDepth": max(resident_depth, 1),
     })
     # flight recorder on: the phase split below is what lets a future
     # BENCH_r*.json attribute a throughput regression to a phase instead
@@ -283,6 +288,11 @@ def _bench_ordered(n_nodes: int, num_instances: int, batches: int,
         "readback_overlap_fraction": round(
             pool.vote_group.readbacks_overlapped
             / max(pool.vote_group.readbacks, 1), 4),
+        # multi-tick residency: ring depth + how many host readbacks
+        # the resident window actually deferred (depth 1 = per-tick)
+        "resident_depth": pool.vote_group.resident_depth,
+        "resident_ticks": pool.vote_group.resident_ticks,
+        "readbacks_deferred": pool.vote_group.readbacks_deferred,
     }
     # per-phase latency attribution (VIRTUAL protocol time): which 3PC
     # phase the ordered batches spent their latency in, and which phase
@@ -373,6 +383,48 @@ def bench_ordered_txns_n64_rbft() -> dict:
         host_accounting=True)
 
 
+def bench_ordered_txns_n64_resident() -> dict:
+    """PR 19 tentpole sub-bench: the SAME n=64 ordered workload run
+    per-tick vs with multi-tick device residency (depth-4 ring of
+    device-side scatter slots, checkpoint slides folded into the fused
+    consume). The digests must match bit-for-bit — residency changes
+    WHEN the host looks at the device, never what the pool orders — and
+    the metric is the resident arm's device dispatches per ordered
+    batch (the ISSUE 19 target: <= 1.0, vs ~1.5 per-tick)."""
+    depth = int(os.environ.get("BENCH_RESIDENT_DEPTH", "4"))
+    per_tick = _bench_ordered(
+        64, 1, batches=4,
+        metric="ordered_txns_per_sec_n64_per_tick_for_resident_compare",
+        note="per-tick arm of the residency comparison")
+    resident = _bench_ordered(
+        64, 1, batches=4,
+        metric="ordered_txns_per_sec_n64_resident",
+        note="depth-%d resident ring; vs the same 100 txns/sec CPU "
+             "estimate as the 1-device n=64 bench" % depth,
+        resident_depth=depth)
+    assert resident["ordered_hash"] == per_tick["ordered_hash"], \
+        "resident ordering diverged from the per-tick run"
+    out = dict(resident)
+    out["metric"] = "resident_n64_dispatches_per_ordered_batch"
+    out["value"] = resident["device_dispatches_per_ordered_batch"]
+    out["unit"] = ("device dispatches per ordered batch, n=64 with a "
+                   "depth-%d resident ring (target <= 1.0)" % depth)
+    out["vs_baseline"] = (
+        round(resident["device_dispatches_per_ordered_batch"]
+              / per_tick["device_dispatches_per_ordered_batch"], 3)
+        if per_tick["device_dispatches_per_ordered_batch"] else None)
+    out["baseline_note"] = (
+        "vs_baseline = resident dispatches/ordered-batch over the "
+        "per-tick figure (lower = the ring amortizes host round-trips);"
+        " throughputs for both arms recorded alongside")
+    out["digests_match_per_tick"] = True
+    out["per_tick_txns_per_sec"] = per_tick["value"]
+    out["per_tick_dispatches_per_ordered_batch"] = \
+        per_tick["device_dispatches_per_ordered_batch"]
+    out["resident_txns_per_sec"] = resident["value"]
+    return out
+
+
 def _rerun_with_virtual_devices(fn_name: str, n_devices: int = 8,
                                 timeout: int = 900) -> dict:
     """Re-execute one bench in a SUBPROCESS with an n-device virtual
@@ -388,6 +440,10 @@ def _rerun_with_virtual_devices(fn_name: str, n_devices: int = 8,
     flags.append(f"--xla_force_host_platform_device_count={n_devices}")
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
+    # residency knob rides into the subprocess so the fabric bench's
+    # re-executed arms exercise the resident path at the same depth
+    env.setdefault("BENCH_RESIDENT_DEPTH",
+                   os.environ.get("BENCH_RESIDENT_DEPTH", "4"))
     code = (
         "import json, sys, jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
@@ -497,6 +553,18 @@ def bench_fabric() -> dict:
     assert single["ordered_hash"] == one_axis["ordered_hash"] \
         == fabric["ordered_hash"], \
         "fabric ordering diverged across placements"
+    # resident arm (PR 19): the same fabric workload with the depth-N
+    # device-resident ring — placement AND residency are both free
+    res_depth = int(os.environ.get("BENCH_RESIDENT_DEPTH", "4"))
+    resident = _bench_ordered(
+        n, 1, batches=batches,
+        metric="ordered_txns_per_sec_n256_fabric_4x2_resident",
+        note="n=256 on the (4, 2) fabric with a depth-%d resident "
+             "ring" % res_depth,
+        mesh=make_fabric_mesh(devices, (4, 2)),
+        resident_depth=res_depth)
+    assert resident["ordered_hash"] == fabric["ordered_hash"], \
+        "resident fabric ordering diverged from the per-tick fabric run"
     out = dict(fabric)
     out["metric"] = "fabric_n256_dispatches_per_ordered_batch"
     out["value"] = fabric["device_dispatches_per_ordered_batch"]
@@ -519,6 +587,13 @@ def bench_fabric() -> dict:
     out["n256_single_device_txns_per_sec"] = single["value"]
     out["n256_one_axis_txns_per_sec"] = one_axis["value"]
     out["n256_fabric_txns_per_sec"] = fabric["value"]
+    out["digests_match_resident"] = True
+    out["resident_depth"] = resident["resident_depth"]
+    out["resident_ticks"] = resident["resident_ticks"]
+    out["readbacks_deferred"] = resident["readbacks_deferred"]
+    out["n256_resident_txns_per_sec"] = resident["value"]
+    out["n256_resident_dispatches_per_ordered_batch"] = \
+        resident["device_dispatches_per_ordered_batch"]
     return out
 
 
@@ -2112,6 +2187,7 @@ def main() -> None:
         "ordered": bench_ordered_txns_n64,
         "rbft": bench_ordered_txns_n64_rbft,
         "sharded": bench_ordered_txns_n64_sharded,
+        "resident": bench_ordered_txns_n64_resident,
         "fabric": bench_fabric,
         "lanes": bench_lanes,
         "ordered100": bench_ordered_txns_n100,
@@ -2208,6 +2284,12 @@ def main() -> None:
                 row.append([e["eval_mode"],
                             e.get("readback_bytes_per_readback"),
                             e.get("readback_overlap_fraction")])
+            if (e.get("resident_depth") or 0) > 1:
+                # multi-tick residency: [ring depth, resident ticks,
+                # readbacks deferred] — depth-1 (per-tick) rows omit it
+                row.append([e["resident_depth"],
+                            e.get("resident_ticks"),
+                            e.get("readbacks_deferred")])
             if e.get("lane_scaling") is not None:
                 # multi-lane ordering: [tps 1-lane, 2-lane, 4-lane,
                 # 4-lane speedup]

@@ -112,6 +112,8 @@ class ChaosReport:
             cmd += " --adaptive-tick"
         if mode.get("mesh"):
             cmd += f" --mesh {mode['mesh']}"
+        if mode.get("resident"):
+            cmd += f" --resident-depth {mode['resident']}"
         if mode.get("trace"):
             cmd += " --trace"
         if mode.get("lanes"):

@@ -428,7 +428,8 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                  mesh=None,
                  host_eval: bool = False,
                  trace: bool = False,
-                 trace_out: Optional[str] = None) -> ChaosReport:
+                 trace_out: Optional[str] = None,
+                 resident_depth: int = 0) -> ChaosReport:
     """``device_quorum`` + ``quorum_tick_interval`` > 0 route the scenario
     through the tick-batched dispatch plane (grouped device flushes, per-
     tick quorum evaluation) — fault paths must survive the tick barrier
@@ -449,9 +450,23 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     ``flight_recorder``, and the report carries ``trace_hash`` — a
     replay of the same seed must reproduce it bit-for-bit.
     ``trace_out`` additionally dumps the whole ring as JSONL
-    (``scripts/trace_tool.py`` consumes it)."""
+    (``scripts/trace_tool.py`` consumes it).
+    ``resident_depth`` > 1 arms multi-tick device residency on the tick
+    plane (votes accumulate in device-side ring slots across that many
+    ticks before one fused step consumes them) — fault paths must
+    survive the deferred-readback window bit-for-bit, which the
+    residency chaos test asserts."""
     if mesh is not None and not device_quorum:
         raise ValueError("mesh requires device_quorum")
+    if resident_depth > 1:
+        if quorum_tick_interval <= 0 or not device_quorum:
+            raise ValueError(
+                "resident_depth requires the tick-batched dispatch "
+                "plane (device_quorum=True, quorum_tick_interval > 0)")
+        if host_eval:
+            raise ValueError("resident_depth is a device-eval "
+                             "optimization; host_eval would silently "
+                             "run per-tick")
     if quorum_tick_interval > 0 and not device_quorum:
         # the services gate tick mode on having a vote plane: without
         # device_quorum the override would silently run the plain
@@ -463,10 +478,11 @@ def run_scenario(scenario: "str | Scenario", seed: int,
         scenario = get_scenario(scenario)
     n = n_nodes or scenario.n_nodes
     if scenario.lanes > 1:
-        if mesh is not None or host_eval:
+        if mesh is not None or host_eval or resident_depth > 1:
             raise ValueError(
-                "laned scenarios run per-lane vote planes; mesh/host_eval"
-                " overrides are not supported on the laned path")
+                "laned scenarios run per-lane vote planes; mesh/"
+                "host_eval/resident_depth overrides are not supported "
+                "on the laned path")
         return _run_laned_scenario(
             scenario, seed, n, out_path, probe_interval, device_quorum,
             quorum_tick_interval, quorum_tick_adaptive, trace, trace_out)
@@ -476,6 +492,8 @@ def run_scenario(scenario: "str | Scenario", seed: int,
     if quorum_tick_interval > 0:
         overrides["QuorumTickInterval"] = quorum_tick_interval
         overrides["QuorumTickAdaptive"] = quorum_tick_adaptive
+    if resident_depth > 1:
+        overrides["ResidentTickDepth"] = resident_depth
     config = getConfig(overrides)
     saturating = scenario.workload_rate > 0
     if saturating and (quorum_tick_interval <= 0 or not device_quorum):
@@ -622,6 +640,7 @@ def run_scenario(scenario: "str | Scenario", seed: int,
                      if mesh is not None else 0),
             "host_eval": host_eval,
             "trace": trace,
+            "resident": resident_depth,
         },
         plan=plan.as_dicts(),
         trace=list(scheduler.trace),

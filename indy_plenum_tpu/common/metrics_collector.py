@@ -90,6 +90,14 @@ class MetricsName:
     # snapshots can label the bytes they report.
     DEVICE_READBACK_BYTES = "device.readback_bytes"
     DEVICE_READBACK_COMPACT = "device.readback_compact"
+    # multi-tick device residency (tpu/vote_plane.py): the configured
+    # ring depth (gauge, recorded once when a group runs resident),
+    # ticks whose votes rode the ring instead of dispatching, and ticks
+    # whose compact readback deferred behind residency — together the
+    # measured amortization of the fused multi-tick consume
+    DEVICE_RESIDENT_DEPTH = "device.resident_depth"
+    DEVICE_RESIDENT_TICKS = "device.resident_ticks"
+    DEVICE_READBACKS_DEFERRED = "device.readbacks_deferred"
     # dispatch governor (adaptive tick, tpu/governor.py): the effective
     # interval after every tick (Stat.last = the CURRENT interval; the
     # histogram records how long the pool dwelt on each rung) and the

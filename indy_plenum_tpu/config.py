@@ -162,6 +162,28 @@ class Config:
     # learning only starts after a warm-up window, so short runs keep
     # the static ladder's exact behaviour.
     FlushLadderAdaptive: bool = True
+    # Multi-tick device residency (tpu/vote_plane.py): with depth N > 1
+    # the tick-batched group ENQUEUES each tick's scatter words into a
+    # device-side ring (async device_put — a transfer, not an XLA
+    # dispatch) and dispatches ONE fused step per up-to-N ticks, with
+    # checkpoint slides folded in as per-slot operands — quorum verdicts
+    # may lag up to N ticks but ordered CONTENT is bit-identical to the
+    # per-tick path (PR 2's timing-robustness law; the residency gate
+    # asserts it). 1 = off (the per-tick PR 7/9 behaviour, bit-exact).
+    # Device-eval only: host_eval groups fall back to per-tick.
+    ResidentTickDepth: int = 1
+    # Occupancy-driven shard rebalancing (tpu/rebalance.py): when the
+    # hottest member block's occupancy EWMA exceeds the median by this
+    # factor for RebalanceDwellTicks consecutive ticks, the policy plans
+    # a member-plane rotation (ring_shift_planes) executed at the next
+    # checkpoint-boundary slide — the rebalance barrier. 0 = disabled
+    # (the policy is not even constructed). Member-sharded groups only.
+    RebalanceSkewThreshold: float = 0.0
+    RebalanceDwellTicks: int = 8
+    # Testing/chaos hook: force ONE planned rotation at exactly this
+    # tick ordinal regardless of skew (0 = off) — digest-identity arms
+    # rebalance deterministically without engineering a hot shard.
+    RebalanceForceTick: int = 0
 
     # --- ingress plane (admission control + backpressure) -----------------
     # Bounded auth queue (ingress/admission.py): client writes queue up to

@@ -203,6 +203,13 @@ class TraceRecorder:
             return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
         drop = set(exclude_cats)
         evs = [e for e in self.events() if e.get("cat") not in drop]
+        # renumber seq within the retained stream: seq is a same-ts
+        # tiebreaker over ALL events, so without this an excluded
+        # category's event COUNT would leak into the fingerprint (a
+        # rebalanced arm emits extra dispatch marks and every later
+        # protocol event's seq shifts by one)
+        for i, e in enumerate(evs):
+            e["seq"] = i
         return hashlib.sha256(events_to_jsonl(evs).encode()).hexdigest()
 
     def clear(self) -> None:
@@ -523,10 +530,26 @@ def overlap_report(events: List[Dict[str, Any]],
     arg) and ``flush.dispatch`` splits its votes per occupancy-grid cell
     (``shard_votes``), so the ``per_shard`` block — readback bytes per
     member shard, votes/share per cell — makes a hot shard visible from
-    a trace dump alone."""
+    a trace dump alone.
+
+    Multi-tick residency runs stage votes with ``flush.enqueue`` spans
+    (these carry the votes/shard_votes; the fused ``flush.dispatch``
+    then covers several ticks via its ``ticks`` arg) and record
+    ``flush.defer`` when a tick ends with the ring still accumulating.
+    Such traces grow per-tick ``enqueues``/``resident_ticks``/
+    ``deferred`` columns plus a ``residency`` summary; traces with no
+    resident events are byte-identical to before. ``rebalance.planned``
+    / ``rebalance.executed`` records surface as a ``rebalances`` block
+    with their marks."""
     ticks: List[Dict[str, Any]] = []
     cur = {"dispatches": 0, "votes": 0, "readbacks": 0, "overlapped": 0,
            "readback_bytes": 0}
+    rcur = {"enqueues": 0, "resident_ticks": 0, "deferred": 0}
+    resident_seen = False
+    rtotals = {"enqueues": 0, "resident_ticks_total": 0,
+               "readbacks_deferred": 0}
+    rebalance_marks: List[Dict[str, Any]] = []
+    rebalances_executed = 0
     shard_bytes: Dict[int, int] = {}
     shard_readbacks: Dict[int, int] = {}
     cell_votes: List[int] = []
@@ -545,6 +568,10 @@ def overlap_report(events: List[Dict[str, Any]],
         if name == "flush.dispatch":
             cur["dispatches"] += 1
             cur["votes"] += args.get("votes", 0)
+            if "resident" in args:
+                resident_seen = True
+                rcur["resident_ticks"] += args.get("ticks", 0)
+                rtotals["resident_ticks_total"] += args.get("ticks", 0)
             sv = args.get("shard_votes")
             if sv:
                 if len(pend_cell_votes) < len(sv):
@@ -552,6 +579,29 @@ def overlap_report(events: List[Dict[str, Any]],
                         [0] * (len(sv) - len(pend_cell_votes)))
                 for ci, v in enumerate(sv):
                     pend_cell_votes[ci] += v
+        elif name == "flush.enqueue":
+            # resident staging: votes counted HERE (the fused dispatch
+            # carries none, so totals stay single-counted)
+            resident_seen = True
+            rcur["enqueues"] += 1
+            rtotals["enqueues"] += 1
+            cur["votes"] += args.get("votes", 0)
+            sv = args.get("shard_votes")
+            if sv:
+                if len(pend_cell_votes) < len(sv):
+                    pend_cell_votes.extend(
+                        [0] * (len(sv) - len(pend_cell_votes)))
+                for ci, v in enumerate(sv):
+                    pend_cell_votes[ci] += v
+        elif name == "flush.defer":
+            resident_seen = True
+            rcur["deferred"] += 1
+            rtotals["readbacks_deferred"] += 1
+        elif name in ("rebalance.planned", "rebalance.executed"):
+            rebalance_marks.append({"name": name, "ts": ev["ts"],
+                                    "args": dict(args)})
+            if name == "rebalance.executed":
+                rebalances_executed += 1
         elif name == "flush.readback":
             cur["readbacks"] += 1
             cur["readback_bytes"] += args.get("bytes", 0)
@@ -565,9 +615,12 @@ def overlap_report(events: List[Dict[str, Any]],
                     pend_shard_readbacks.get(shard, 0) + 1
         elif name == "tick.flush":
             cur["ts"] = ev["ts"]
+            if resident_seen:
+                cur.update(rcur)
             ticks.append(cur)
             cur = {"dispatches": 0, "votes": 0, "readbacks": 0,
                    "overlapped": 0, "readback_bytes": 0}
+            rcur = {"enqueues": 0, "resident_ticks": 0, "deferred": 0}
             for s, b in pend_shard_bytes.items():
                 shard_bytes[s] = shard_bytes.get(s, 0) + b
             for s, n in pend_shard_readbacks.items():
@@ -597,6 +650,11 @@ def overlap_report(events: List[Dict[str, Any]],
         },
         "per_tick": ticks,
     }
+    if resident_seen:
+        out["residency"] = dict(rtotals)
+    if rebalance_marks:
+        out["rebalances"] = {"executed": rebalances_executed,
+                             "marks": rebalance_marks}
     if shard_bytes or cell_votes:
         n_shards = max([s + 1 for s in shard_bytes] or [0])
         total_votes = sum(cell_votes)

@@ -188,6 +188,19 @@ class Monitor:
                 if mode is not None:
                     device["eval_mode"] = ("device" if mode.last
                                            else "host")
+            # multi-tick residency: present only when a group ran with
+            # ResidentTickDepth > 1 — zero-residency snapshots stay
+            # byte-compatible
+            depth = self._metrics.stat(MetricsName.DEVICE_RESIDENT_DEPTH)
+            if depth is not None and depth.last:
+                rt = self._metrics.stat(MetricsName.DEVICE_RESIDENT_TICKS)
+                rd = self._metrics.stat(
+                    MetricsName.DEVICE_READBACKS_DEFERRED)
+                device["residency"] = {
+                    "resident_depth": int(depth.last),
+                    "resident_ticks": int(rt.count) if rt else 0,
+                    "readbacks_deferred": int(rd.count) if rd else 0,
+                }
             shard_count = self._metrics.stat(MetricsName.DEVICE_SHARD_COUNT)
             if shard_count is not None and shard_count.last:
                 n_shards = int(shard_count.last)

@@ -649,6 +649,8 @@ class SimPool:
         # adaptive tick mode: the governor's interval trajectory is a
         # first-class observable (bench digests, determinism tests)
         self.governor = getattr(self._quorum_tick_timer, "governor", None)
+        # occupancy-driven rebalance policy (None unless sharded + armed)
+        self.rebalance = getattr(self._quorum_tick_timer, "rebalance", None)
 
     def _install_accounting(self, node: "SimNode") -> None:
         import time as _time

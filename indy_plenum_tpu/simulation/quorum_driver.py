@@ -49,7 +49,9 @@ def make_vote_group(n_nodes: int, validators, config: Config,
     ``host_eval`` selects the full-event-matrix readback fallback over
     the default on-device quorum eval + compact delta readback.
     ``config.FlushLadderAdaptive`` hands the padded flush width to the
-    learned per-pool ladder."""
+    learned per-pool ladder; ``config.ResidentTickDepth`` > 1 turns on
+    the multi-tick residency ring (one fused device dispatch per
+    up-to-N ticks)."""
     from ..tpu.vote_plane import VotePlaneGroup
 
     return VotePlaneGroup(
@@ -58,7 +60,8 @@ def make_vote_group(n_nodes: int, validators, config: Config,
         n_checkpoints=max(1, config.LOG_SIZE // config.CHK_FREQ),
         mesh=mesh, pipelined=pipelined, metrics=metrics,
         adaptive_ladder=config.FlushLadderAdaptive,
-        host_eval=host_eval)
+        host_eval=host_eval,
+        resident_depth=config.ResidentTickDepth)
 
 
 def drive_group_ticks(timer: TimerService, config: Config, vote_group,
@@ -104,6 +107,13 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
     governor = DispatchGovernor.from_config(config,
                                             metrics=vote_group.metrics,
                                             trace=trace)
+    # occupancy-driven rebalancing (tpu/rebalance.py): None unless the
+    # group is member-sharded AND a trigger is armed — common runs pay
+    # nothing. The policy only PLANS here; the group executes at its
+    # next checkpoint-boundary slide (the rebalance barrier).
+    from ..tpu.rebalance import RebalancePolicy
+
+    rebalance = RebalancePolicy.from_config(config, vote_group)
     last = [vote_group.flushes, vote_group.flush_votes_total,
             vote_group.flush_capacity_total]
     # per-shard baselines (length 1 when unsharded): the governor's law
@@ -158,6 +168,16 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
                     "tick.governor", cat="dispatch",
                     args={"interval": round(new_interval, 9),
                           "occupancy_ewma": round(governor.ewma, 6)})
+        if rebalance is not None:
+            rows = rebalance.observe(
+                governor.shard_ewmas if governor is not None else None)
+            if rows:
+                if trace.enabled:
+                    trace.record(
+                        "rebalance.planned", cat="dispatch",
+                        args={"rows": rows,
+                              "skew": round(rebalance.last_skew, 4)})
+                vote_group.schedule_rebalance(rows)
         last[:] = [vote_group.flushes, vote_group.flush_votes_total,
                    vote_group.flush_capacity_total]
         last_shard[0] = list(vote_group.flush_votes_per_shard)
@@ -184,6 +204,7 @@ def drive_group_ticks(timer: TimerService, config: Config, vote_group,
     rt = RepeatingTimer(timer, interval, tick, barrier=True)
     timer_box.append(rt)
     rt.governor = governor
+    rt.rebalance = rebalance
     return rt
 
 

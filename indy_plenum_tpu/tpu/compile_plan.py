@@ -97,6 +97,83 @@ def _slide_body(states: q.VoteState, deltas: jnp.ndarray) -> q.VoteState:
 
 
 @functools.lru_cache(maxsize=None)
+def resident_plan_for(mesh: Optional[Mesh], n_validators: int,
+                      n_validator_rows: int, delta_cap: int,
+                      n_slots: int, width: int) -> Callable:
+    """Fused multi-slot consume for the residency ring.
+
+    ``step(states, slides, *words)`` -> (states, events, compact) where
+    ``slides`` is ``(n_slots, M) int32`` (per-slot folded window-slide
+    deltas, applied BEFORE that slot's scatter) and each of the
+    ``n_slots`` word buffers is ``(M, width) uint32``. The kernel chains
+    slide+scatter per slot and evaluates quorums ONCE at the end — k
+    resident ticks ride one dispatch and one compact readback. Slot
+    width is fixed by the caller (the group's ``flush_batch``) so the
+    compile cache stays bounded by (mesh, n, rows, cap, k, width)
+    instead of growing a kernel per adaptive ladder rung.
+
+    Deferred eval is report-equivalent to per-tick eval (see
+    :func:`~indy_plenum_tpu.tpu.quorum.eval_compact`): certs dedup via
+    ``prepared_acked``/``ordered``, and a folded slide can only drop
+    slots whose certs the host already absorbed — the host issues a
+    slide only after SEEING checkpoint stability in a readback."""
+    if mesh is None:
+        def step_impl(states, slides, *words_seq):
+            for k in range(n_slots):
+                states = _slide_body(states, slides[k])
+                msgs = q.unpack_words(words_seq[k])
+                states = jax.vmap(q.scatter_batch)(states, msgs)
+            return jax.vmap(
+                lambda s: q.eval_compact(s, n_validators, delta_cap)
+            )(states)
+
+        return functools.partial(
+            jax.jit, donate_argnums=_state_donation())(step_impl)
+
+    axes = mesh.axis_names
+    member_axis = axes[0]
+    validator_axis = axes[1] if len(axes) > 1 else None
+    state_spec, row_spec, events_spec, vec_spec = q.member_sharded_specs(
+        member_axis, validator_axis)
+    compact_spec = q.compact_member_specs(member_axis)
+    slides_spec = P(None, member_axis)
+
+    if validator_axis is None:
+        def step_impl(states, slides, *words_seq):
+            for k in range(n_slots):
+                states = _slide_body(states, slides[k])
+                msgs = q.unpack_words(words_seq[k])
+                states = jax.vmap(q.scatter_batch)(states, msgs)
+            return jax.vmap(
+                lambda s: q.eval_compact(s, n_validators, delta_cap)
+            )(states)
+    else:
+        v_shards = int(mesh.shape[validator_axis])
+        assert n_validator_rows % v_shards == 0, (n_validator_rows, v_shards)
+        v_local = n_validator_rows // v_shards
+
+        def step_impl(states, slides, *words_seq):
+            offset = (lax.axis_index(validator_axis).astype(jnp.int32)
+                      * v_local)
+            for k in range(n_slots):
+                states = _slide_body(states, slides[k])
+                msgs = q.unpack_words(words_seq[k])
+                states = jax.vmap(
+                    lambda s, m: q.scatter_batch(s, m, offset, v_local)
+                )(states, msgs)
+            return jax.vmap(
+                lambda s: q.eval_compact(
+                    s, n_validators, delta_cap, validator_axis)
+            )(states)
+
+    return functools.partial(jax.jit, donate_argnums=_state_donation())(
+        q.shard_map_compat(
+            step_impl, mesh=mesh,
+            in_specs=(state_spec, slides_spec) + (row_spec,) * n_slots,
+            out_specs=(state_spec, events_spec, compact_spec)))
+
+
+@functools.lru_cache(maxsize=None)
 def plan_for(mesh: Optional[Mesh], n_validators: int,
              n_validator_rows: int, delta_cap: int) -> CompilePlan:
     """Resolve the compilation plan for a :class:`VotePlaneGroup`.

@@ -319,6 +319,42 @@ def step_compact_local(
     return compact_from_events(state, events, delta_cap)
 
 
+def scatter_batch(
+    state: VoteState, msgs: MsgBatch,
+    row_offset: Optional[jnp.ndarray] = None,
+    local_rows: Optional[int] = None,
+) -> VoteState:
+    """Scatter-only half of the fused step: fold a message batch into the
+    vote tensors WITHOUT evaluating quorums. The multi-tick residency
+    kernel (``compile_plan.resident_plan_for``) chains one scatter per
+    ring slot — interleaved with the folded window slides — and runs
+    :func:`eval_compact` once at the end, so k resident ticks cost one
+    quorum evaluation instead of k. ``row_offset``/``local_rows`` carry
+    the validator-sharded variant (2-axis fabric); defaults scatter the
+    full local row block."""
+    if row_offset is None:
+        row_offset = jnp.zeros((), jnp.int32)
+    if local_rows is None:
+        local_rows = state.prepare_votes.shape[0]
+    return _scatter_local(state, msgs, row_offset, local_rows)
+
+
+def eval_compact(
+    state: VoteState, n_validators: int,
+    delta_cap: int = ORDER_DELTA_CAP, axis_name: Optional[str] = None,
+) -> Tuple[VoteState, QuorumEvents, CompactEvents]:
+    """Eval-only half of the fused step: quorum detection + frontier
+    advance + compact deltas over the CURRENT vote tensors (no scatter).
+    Deferring this behind k chained :func:`scatter_batch` calls is
+    equivalent to per-tick evaluation for everything the host consumes:
+    ``prepared_acked``/``ordered`` dedup each cert exactly once per
+    window epoch regardless of which step detects it, and any slot a
+    folded slide drops was (by the checkpoint-stabilization protocol)
+    already certified AND reported before the host issued the slide."""
+    state, events = _quorum_events(state, n_validators, axis_name)
+    return compact_from_events(state, events, delta_cap)
+
+
 def slide_state(state: VoteState, delta: jnp.ndarray) -> VoteState:
     """Roll the slot axis left by ``delta`` and zero the vacated columns
     (the checkpoint-stabilization window slide — the ONE definition both

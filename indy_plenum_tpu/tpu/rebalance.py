@@ -1,0 +1,221 @@
+"""Occupancy-driven member-plane rebalancing for the sharded vote fabric.
+
+The governor (tpu/governor.py) has WATCHED the per-cell occupancy grid
+since PR 4 — its hottest-cell law narrows the tick for the whole pool
+when one shard runs hot — but nothing ever ACTED on the placement. This
+module closes the loop: a deterministic :class:`RebalancePolicy` folds
+the governor's per-cell occupancy EWMAs into per-member-block heats,
+and when the hottest/median skew holds above ``RebalanceSkewThreshold``
+for ``RebalanceDwellTicks`` consecutive ticks, plans a ROTATION of the
+member planes along mesh axis 0 — executed by the
+:class:`~indy_plenum_tpu.tpu.vote_plane.VotePlaneGroup` at its next
+checkpoint-boundary slide (the rebalance barrier: the only instant the
+residency ring is guaranteed drained) through
+:func:`~indy_plenum_tpu.tpu.ring_exchange.ring_shift_planes`.
+
+Why a rotation and not an arbitrary permutation: the fabric's
+device-to-device migration primitive is the ring exchange (ppermute
+reference today, pallas RDMA on real TPUs), which moves whole
+member-shard BLOCKS one ring step — so the policy plans in units the
+interconnect can execute. Whole-block rotations alone are useless
+(block heat is invariant under them), so the plan works in device ROWS:
+a shift of ``s`` rows splits each old block's heat across two adjacent
+new blocks at ratio ``(R - s%R)/R : (s%R)/R`` and block-shifts by
+``s // R`` — :meth:`RebalancePolicy.plan` picks the ``s`` minimizing
+the predicted hottest block.
+
+Determinism: the policy is a pure fold over the EWMA series it is shown
+(no clocks, no randomness) — same seeded run, same plans, asserted by
+tests/test_residency.py and the residency gate.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import quorum as q
+from .ring_exchange import _member_specs, ring_shift_planes
+
+
+class RebalancePolicy:
+    """Deterministic skew-threshold/dwell law over per-cell occupancy.
+
+    ``observe(shard_ewmas)`` is called once per tick with the governor's
+    flattened occupancy-EWMA grid (cell ``i * v_shards + j`` = member
+    block i x validator block j) and returns the planned rotation in
+    device ROWS (0 = no plan). After a plan, a cooldown window mutes the
+    law while the post-rotation EWMAs re-learn the new placement —
+    without it the stale pre-rotation transient would immediately
+    re-trigger. ``force_tick`` (the testing/chaos hook behind the
+    ``RebalanceForceTick`` knob) plans one rotation unconditionally at
+    exactly that tick ordinal, so digest-identity arms can rebalance
+    deterministically without engineering a hot shard."""
+
+    def __init__(self, m_shards: int, shard_rows: int, v_shards: int = 1,
+                 threshold: float = 0.0, dwell: int = 8,
+                 force_tick: int = 0, cooldown: Optional[int] = None):
+        if m_shards < 1 or shard_rows < 1 or v_shards < 1:
+            raise ValueError("mesh shape must be positive")
+        self._m = int(m_shards)
+        self._rows = int(shard_rows)
+        self._v = int(v_shards)
+        self._threshold = float(threshold)
+        self._dwell = max(1, int(dwell))
+        self._force = int(force_tick)
+        self._cool_len = (4 * self._dwell if cooldown is None
+                          else max(0, int(cooldown)))
+        self._tick = 0
+        self._over = 0       # consecutive over-threshold ticks
+        self._cooldown = 0   # ticks left before the law re-arms
+        self.last_skew = 0.0
+        self.planned = 0     # rotations this policy has planned
+
+    @property
+    def threshold(self) -> float:
+        return self._threshold
+
+    @property
+    def dwell(self) -> int:
+        return self._dwell
+
+    @property
+    def shard_rows(self) -> int:
+        return self._rows
+
+    def block_heat(self, shard_ewmas: Sequence[float]) -> List[float]:
+        """Fold the flattened occupancy grid into per-member-block heat
+        (mean over each block's validator cells — rotation moves member
+        planes, so the member axis is the one the plan can change)."""
+        return [
+            sum(shard_ewmas[i * self._v:(i + 1) * self._v]) / self._v
+            for i in range(self._m)]
+
+    @staticmethod
+    def skew(block_heat: Sequence[float]) -> float:
+        """Hottest/median block heat (median of an even count is the
+        mean of the middle two) — THE skew every surface reports."""
+        heats = sorted(block_heat)
+        n = len(heats)
+        med = (heats[n // 2] if n % 2
+               else (heats[n // 2 - 1] + heats[n // 2]) / 2.0)
+        return max(heats) / max(med, 1e-9)
+
+    def plan(self, block_heat: Sequence[float]) -> int:
+        """Rotation (in device rows) minimizing the predicted hottest
+        block, 0 if no rotation strictly improves it. A shift of ``s``
+        rows re-partitions the member sequence so new block k holds the
+        last ``s % R`` rows of old block ``k - s//R - 1`` and the first
+        ``R - s%R`` rows of old block ``k - s//R`` — heat splits
+        proportionally (rows within a block are not individually
+        metered; the uniform split is the unbiased estimate). Smallest
+        winning ``s`` ties-break, so plans are deterministic."""
+        heat = list(block_heat)
+        n_blocks = len(heat)
+        rows = self._rows
+        best_s, best_max = 0, max(heat)
+        for s in range(1, n_blocks * rows):
+            b0, r = divmod(s, rows)
+            w_hi = (rows - r) / rows
+            w_lo = r / rows
+            pred = max(
+                w_hi * heat[(k - b0) % n_blocks]
+                + w_lo * heat[(k - b0 - 1) % n_blocks]
+                for k in range(n_blocks))
+            if pred < best_max - 1e-12:
+                best_s, best_max = s, pred
+        return best_s
+
+    def observe(self, shard_ewmas: Optional[Sequence[float]]) -> int:
+        """One tick of the law; returns the planned rotation in device
+        rows (0 almost always)."""
+        self._tick += 1
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return 0
+        heat = None
+        if shard_ewmas is not None \
+                and len(shard_ewmas) == self._m * self._v:
+            heat = self.block_heat(shard_ewmas)
+            self.last_skew = self.skew(heat)
+        if self._force and self._tick == self._force:
+            self._over = 0
+            self._cooldown = self._cool_len
+            s = self.plan(heat) if heat else 0
+            if not s:
+                s = max(1, self._rows // 2)  # forced arm always rotates
+            self.planned += 1
+            return s
+        if self._threshold <= 0 or heat is None or self._m < 2:
+            return 0
+        if self.last_skew > self._threshold:
+            self._over += 1
+        else:
+            self._over = 0
+        if self._over >= self._dwell:
+            self._over = 0
+            self._cooldown = self._cool_len
+            s = self.plan(heat)
+            if s:
+                self.planned += 1
+            return s
+        return 0
+
+    @classmethod
+    def from_config(cls, config, vote_group) -> Optional["RebalancePolicy"]:
+        """The composition-root constructor: None unless the group is
+        member-sharded AND a trigger is armed (skew law or force hook) —
+        the common path pays nothing."""
+        if vote_group is None or getattr(vote_group, "_m_shards", 1) < 2:
+            return None
+        if (config.RebalanceSkewThreshold <= 0
+                and config.RebalanceForceTick <= 0):
+            return None
+        return cls(vote_group._m_shards, vote_group._shard_rows,
+                   vote_group._v_shards,
+                   threshold=config.RebalanceSkewThreshold,
+                   dwell=config.RebalanceDwellTicks,
+                   force_tick=config.RebalanceForceTick)
+
+
+def rotate_planes(states, mesh, rows: int, shard_rows: int):
+    """Rotate every member plane ``rows`` device rows along the member
+    axis (row r's plane moves to row ``(r + rows) % M``).
+
+    On a mesh this composes from primitives the interconnect can run:
+    ``rows = b*R + s`` splits into whole-block ring shifts
+    (:func:`ring_shift_planes` — ppermute reference / pallas RDMA) by
+    ``b`` and ``b + 1``, merged shard-locally — new local row r takes
+    the b-shift arm's row ``r - s`` when ``r >= s`` and the (b+1)-shift
+    arm's row ``r - s + R`` otherwise (both are shard-local rolls, no
+    extra collective). Unsharded it is a plain roll (tests and the
+    degenerate 1-device mesh)."""
+    if mesh is None:
+        return jax.tree.map(
+            lambda x: jnp.roll(x, rows, axis=0), states)
+    b0, s = divmod(int(rows), int(shard_rows))
+    shifted = ring_shift_planes(states, mesh, b0)
+    if s == 0:
+        return shifted
+    shifted_up = ring_shift_planes(states, mesh, b0 + 1)
+    axis = mesh.axis_names[0]
+    validator_axis = (mesh.axis_names[1]
+                      if len(mesh.axis_names) > 1 else None)
+    specs = _member_specs(states, axis, validator_axis)
+
+    def merge(a, b):
+        def leaf(x, y):
+            idx = jnp.arange(shard_rows).reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+            return jnp.where(idx >= s,
+                             jnp.roll(x, s, axis=0),
+                             jnp.roll(y, s, axis=0))
+
+        return jax.tree.map(leaf, a, b)
+
+    return jax.jit(q.shard_map_compat(
+        merge, mesh=mesh, in_specs=(specs, specs),
+        out_specs=specs))(shifted, shifted_up)

@@ -149,7 +149,8 @@ class PlaneDeltas(NamedTuple):
 
 # the donation gate lives with the compilation plans now (one definition
 # for the standalone jits here AND every plan compile_plan.py builds)
-from .compile_plan import _state_donation, plan_for  # noqa: E402
+from .compile_plan import (  # noqa: E402
+    _state_donation, plan_for, resident_plan_for)
 
 
 @functools.lru_cache(maxsize=None)
@@ -549,7 +550,8 @@ class VotePlaneGroup:
                  mesh=None, pipelined: bool = False,
                  adaptive_ladder: bool = False,
                  host_eval: bool = False,
-                 delta_cap: Optional[int] = None):
+                 delta_cap: Optional[int] = None,
+                 resident_depth: int = 1):
         """``mesh``: an optional :class:`jax.sharding.Mesh` with one or
         two axes (build it with ``q.make_fabric_mesh``). Axis 0 shards
         the member axis of every vote tensor, so one pod's chips split
@@ -754,6 +756,63 @@ class VotePlaneGroup:
         # (overlap attribution)
         self._inflight: Optional[list] = None
         self._inflight_seq = 0
+        # --- multi-tick device residency (README "Multi-tick device
+        # residency & rebalancing"). With resident_depth N > 1, flush()
+        # ENQUEUES each tick's scatter words into a device-side ring
+        # (device_put is a transfer, not an XLA dispatch) and dispatches
+        # ONE fused step per up-to-N ticks via resident_plan_for —
+        # checkpoint slides FOLD into that step as a per-slot operand,
+        # so a slide no longer forces a sync + host re-stage. Quorum
+        # verdicts may lag up to N ticks; ordered CONTENT is
+        # bit-identical to the per-tick path (PR 2's timing-robustness
+        # law, asserted by the residency gate). Depth 1 (the default)
+        # takes none of these paths — bit-identical to PR 7/9. Device
+        # eval only: host_eval falls back to per-tick.
+        self.resident_depth = max(1, int(resident_depth))
+        self._resident = self.resident_depth > 1 and not host_eval
+        # ring slots: (slide_vec | None, staged words, votes, shard_votes)
+        self._ring: list = []
+        self._ring_ticks = 0   # enqueued ticks since the last consume
+        self.resident_ticks = 0        # total ticks that rode the ring
+        self.readbacks_deferred = 0    # ticks whose readback deferred
+        # one FIXED slot width bounds the resident-plan compile cache to
+        # (slots, width) — the adaptive ladder stays a per-tick feature
+        self._resident_width = self.flush_batch
+        self._pending_slide = np.zeros(self._m_pad, np.int32)  # by ROW
+        # cumulative slide per MEMBER, plus the snapshot taken when the
+        # in-flight consume was dispatched: their difference is the
+        # rebase the absorb applies to reported slot indices
+        self._slide_cum = np.zeros(self._m_pad, np.int64)
+        self._inflight_cum = self._slide_cum.copy()
+        if self._resident:
+            self.metrics.add_event(
+                MetricsName.DEVICE_RESIDENT_DEPTH, self.resident_depth)
+        # --- occupancy-driven rebalancing (tpu/rebalance.py): member
+        # planes may ROTATE across device rows at a checkpoint-boundary
+        # barrier; the placement map below translates member index <->
+        # device row everywhere the host touches rows. Host mirrors stay
+        # MEMBER-indexed — the translation IS the h/mirror rotation.
+        self._row_shift = 0
+        self._rebalance_pending = 0
+        self.rebalances = 0
+        self._rebuild_placement()
+
+    def _rebuild_placement(self) -> None:
+        """Recompute the row->member map from the current rotation shift
+        (identity until the first rebalance)."""
+        rows = (np.arange(self._m_pad) - self._row_shift) % self._m_pad
+        self._row_member = np.where(
+            rows < len(self._members), rows, -1).astype(np.int64)
+        self._row_valid = self._row_member >= 0
+
+    def _row_of(self, member_idx: int) -> int:
+        """Device row currently holding this member's plane."""
+        return (member_idx + self._row_shift) % self._m_pad
+
+    @property
+    def row_shift(self) -> int:
+        """Current member->device-row rotation (0 until a rebalance)."""
+        return self._row_shift
 
     def view(self, member_idx: int) -> "DeviceVotePlane":
         return self._members[member_idx]
@@ -830,6 +889,18 @@ class VotePlaneGroup:
                  self._host_stable) = jax.device_get(
                     (events.prepared, events.prepare_counts,
                      events.commit_counts, events.stable_checkpoints))
+                if self._row_shift:
+                    # host_eval snapshots are ROW-indexed matrices but
+                    # members read them BY INDEX — un-rotate the rows so
+                    # member views keep slicing at their own index
+                    perm = (np.arange(self._m_pad)
+                            + self._row_shift) % self._m_pad
+                    (self._host_prepared, self._host_prepare_counts,
+                     self._host_commit_counts, self._host_stable) = (
+                        self._host_prepared[perm],
+                        self._host_prepare_counts[perm],
+                        self._host_commit_counts[perm],
+                        self._host_stable[perm])
                 self._host_commit_ok = (
                     self._host_commit_counts
                     >= self._n - (self._n - 1) // 3)
@@ -912,11 +983,14 @@ class VotePlaneGroup:
         cap = self._delta_cap
         members = self._members
         rows = host.frontier.shape[0]
-        n_real = min(rows, len(members) - lo)  # pad rows hold nothing
+        # pad rows hold nothing; a rotated placement maps each device
+        # row back to its member (or -1) via the placement map
+        row_member = self._row_member[lo:lo + rows]
+        valid = self._row_valid[lo:lo + rows]
         over_p = np.asarray(host.n_prepared) > cap
         over_c = np.asarray(host.n_committed) > cap
         full_prep = full_ord = None
-        if over_p[:n_real].any() or over_c[:n_real].any():
+        if (over_p & valid).any() or (over_c & valid).any():
             if si is None:
                 full_prep, full_ord = jax.device_get(
                     (events.prepared, events.ordered))
@@ -929,40 +1003,79 @@ class VotePlaneGroup:
         # rows with anything to fold: slot lists are ascending and
         # S-padded, so row[0] < S iff the row is non-empty
         touched = np.nonzero(
-            (host.new_prepared[:n_real, 0] < s)
-            | (host.new_committed[:n_real, 0] < s)
-            | over_p[:n_real] | over_c[:n_real])[0]
+            ((host.new_prepared[:, 0] < s)
+             | (host.new_committed[:, 0] < s)
+             | over_p | over_c) & valid)[0]
+        cum = self._inflight_cum
         for r in touched:
-            mi = lo + int(r)
+            mi = int(row_member[r])
             member = members[mi]
+            # residency slide-fold rebase: slides folded INTO the
+            # consumed steps moved the window AFTER those steps' certs
+            # were detected, so reported slots are in pre-slide
+            # coordinates; shift them down by the slides applied since
+            # the consume was dispatched (0 on every per-tick path —
+            # bit-identical fold)
+            shift_d = int(self._slide_cum[mi] - cum[mi])
             if over_p[r]:
-                new = np.nonzero(full_prep[r]
+                full_row = full_prep[r]
+                if shift_d:
+                    full_row = np.concatenate(
+                        [full_row[shift_d:],
+                         np.zeros(min(shift_d, s), full_row.dtype)])
+                new = np.nonzero(full_row
                                  & ~self._mir_prepared[mi])[0]
             else:
                 row = host.new_prepared[r]
                 new = row[row < s]
+                if shift_d:
+                    new = new[new >= shift_d] - shift_d
             if new.size:
                 self._mir_prepared[mi, new] = True
                 member._delta_prepared.extend(int(x) for x in new)
             if over_c[r]:
-                new = np.nonzero(full_ord[r]
+                full_row = full_ord[r]
+                if shift_d:
+                    full_row = np.concatenate(
+                        [full_row[shift_d:],
+                         np.zeros(min(shift_d, s), full_row.dtype)])
+                new = np.nonzero(full_row
                                  & ~self._mir_commit_ok[mi])[0]
             else:
                 row = host.new_committed[r]
                 new = row[row < s]
+                if shift_d:
+                    new = new[new >= shift_d] - shift_d
             if new.size:
                 self._mir_commit_ok[mi, new] = True
                 member._delta_committed.extend(int(x) for x in new)
-        self._mir_stable[lo:lo + rows] = np.asarray(host.stable)\
-            .astype(bool)
-        self._mir_frontier[lo:lo + rows] = np.asarray(host.frontier)
+        mis = row_member[valid]
+        stable = np.asarray(host.stable).astype(bool)[valid]
+        frontier = np.asarray(host.frontier)[valid]
+        deltas = self._slide_cum[mis] - cum[mis]
+        plain = deltas == 0
+        self._mir_stable[mis[plain]] = stable[plain]
+        self._mir_frontier[mis[plain]] = frontier[plain]
+        if not plain.all():
+            # slid members: the device checkpoint votes the report saw
+            # were zeroed by the folded slide's own roll — keep the
+            # mirror's post-slide False state, and only advance (never
+            # overwrite) the frontier by the rebased report
+            sh = ~plain
+            self._mir_frontier[mis[sh]] = np.maximum(
+                self._mir_frontier[mis[sh]],
+                np.maximum(frontier[sh] - deltas[sh], 0))
         return bytes_n
 
     @property
     def lagging(self) -> bool:
         """True while a dispatched step's events are not yet in the host
-        snapshot (pipelined mode) — quorum state may be newer on device."""
-        return self._inflight is not None
+        snapshot (pipelined mode) — quorum state may be newer on device.
+        A resident-but-unread ring slot counts the same way: its votes
+        are device-bound but not yet evaluated, so the governor's absorb
+        clamp and the services' lost-wakeup guard treat it as
+        in-flight."""
+        return self._inflight is not None or bool(self._ring)
 
     def _stage_scatter(self, chunks: List[List[int]], shape: int,
                        interleave=None):
@@ -990,9 +1103,14 @@ class VotePlaneGroup:
                 out = self._scatter_bufs[shape] = np.zeros(
                     (len(self._members), shape), np.uint32)
             out[...] = 0
-            for i, entries in enumerate(chunks):
-                if entries:
-                    q.fill_words_row(out[i], entries)
+            if self._row_shift:
+                for i, entries in enumerate(chunks):
+                    if entries:
+                        q.fill_words_row(out[self._row_of(i)], entries)
+            else:
+                for i, entries in enumerate(chunks):
+                    if entries:
+                        q.fill_words_row(out[i], entries)
             # forced copy — see the staging-buffer comment in __init__
             # for why asarray would alias and corrupt in-flight
             # dispatches
@@ -1001,9 +1119,15 @@ class VotePlaneGroup:
         for si in range(self._m_shards):
             buf = np.zeros((self._shard_rows, shape), np.uint32)
             base = si * self._shard_rows
-            for r in range(min(self._shard_rows, len(chunks) - base)):
-                if chunks[base + r]:
-                    q.fill_words_row(buf[r], chunks[base + r])
+            if self._row_shift:
+                for r in range(self._shard_rows):
+                    mi = int(self._row_member[base + r])
+                    if 0 <= mi < len(chunks) and chunks[mi]:
+                        q.fill_words_row(buf[r], chunks[mi])
+            else:
+                for r in range(min(self._shard_rows, len(chunks) - base)):
+                    if chunks[base + r]:
+                        q.fill_words_row(buf[r], chunks[base + r])
             arrs.extend(jax.device_put(buf, dev)
                         for dev in self._shard_devices[si])
             if interleave is not None:
@@ -1035,6 +1159,25 @@ class VotePlaneGroup:
             shard_votes[base + min(((w >> 16) & 0x1FFF) // self._v_rows,
                                    self._v_shards - 1)] += 1
 
+    def _collect_chunks(self):
+        """Take one flush-batch chunk from every member's pending queue,
+        attributing votes to occupancy-grid cells under the CURRENT
+        placement map (a rotated member's votes land on — and heat — the
+        rows now holding its plane)."""
+        chunks = []
+        votes = 0
+        shard_votes = [0] * self._n_shards
+        for i, m in enumerate(self._members):
+            take, m._pending = (m._pending[:self.flush_batch],
+                                m._pending[self.flush_batch:])
+            chunks.append(take)
+            votes += len(take)
+            self._cell_votes(
+                shard_votes,
+                (self._row_of(i) // self._shard_rows) * self._v_shards,
+                take)
+        return chunks, votes, shard_votes
+
     def _dispatch_pending(self, interleave=None):
         """Chunk + scatter every member's pending votes (async dispatch);
         returns the list of chained (events, compact) step results, empty
@@ -1042,17 +1185,7 @@ class VotePlaneGroup:
         per-shard absorb generator through the scatter staging."""
         results = []
         while any(m._pending for m in self._members):
-            chunks = []
-            votes = 0
-            shard_votes = [0] * self._n_shards
-            for i, m in enumerate(self._members):
-                take, m._pending = (m._pending[:self.flush_batch],
-                                    m._pending[self.flush_batch:])
-                chunks.append(take)
-                votes += len(take)
-                self._cell_votes(
-                    shard_votes, (i // self._shard_rows) * self._v_shards,
-                    take)
+            chunks, votes, shard_votes = self._collect_chunks()
             # the padded width rides the busiest member: a quiet tick
             # (a few straggler votes) scatters 16-wide, a full protocol
             # wave 128-wide — each rung is one cached XLA compilation.
@@ -1194,6 +1327,10 @@ class VotePlaneGroup:
     def flush(self) -> None:
         """Scatter every member's pending votes; refresh host event caches."""
         self._flush_seq += 1
+        if self._resident:
+            with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
+                self._flush_resident()
+            return
         if self.pipelined:
             with self.metrics.measure_time(MetricsName.DEVICE_FLUSH_TIME):
                 self._flush_pipelined()
@@ -1219,20 +1356,186 @@ class VotePlaneGroup:
             self._absorb_results(
                 results, overlapped=self._flush_seq > self._inflight_seq)
 
-    def slide_member(self, member_idx: int, delta: int) -> None:
-        self.flush()
+    # --- multi-tick residency ring ------------------------------------
+
+    def _take_slide(self) -> Optional[np.ndarray]:
+        """Detach the accumulated pending slide vector (row-indexed) for
+        attachment to the NEXT ring slot — the fused step applies it
+        before that slot's scatter."""
+        if not self._pending_slide.any():
+            return None
+        vec = self._pending_slide
+        self._pending_slide = np.zeros(self._m_pad, np.int32)
+        return vec
+
+    def _enqueue_chunks(self, count_tick: bool = True) -> None:
+        """Stage every member's pending votes into ring slots — async
+        device transfers (device_put), NO XLA dispatch. The host keeps
+        only the slot list (its write cursor); the words live on device
+        until a consume chains them through the fused resident step."""
+        enqueued = False
+        while any(m._pending for m in self._members):
+            chunks, votes, shard_votes = self._collect_chunks()
+            shape = self._resident_width
+            args = None
+            if self.trace.enabled:
+                args = {"votes": votes, "shape": shape}
+                if self._n_shards > 1:
+                    args["shard_votes"] = list(shard_votes)
+            with self.trace.span("flush.enqueue", args=args) \
+                    if self.trace.enabled else _NO_SPAN:
+                words = self._stage_scatter(chunks, shape)
+            self._ring.append((self._take_slide(), words, votes,
+                               shard_votes))
+            capacity = len(self._members) * shape
+            self.flush_votes_total += votes
+            self.flush_capacity_total += capacity
+            self._account_shards(shard_votes, shape)
+            self.metrics.add_event(MetricsName.DEVICE_FLUSH_VOTES, votes)
+            self.metrics.add_event(
+                MetricsName.DEVICE_FLUSH_OCCUPANCY, votes / capacity)
+            enqueued = True
+        if enqueued and count_tick:
+            self._ring_ticks += 1
+            self.resident_ticks += 1
+            self.metrics.add_event(MetricsName.DEVICE_RESIDENT_TICKS)
+
+    def _consume_ring(self, sync: bool = False) -> None:
+        """Dispatch ONE fused step consuming every ring slot (slides
+        folded in per slot, quorums evaluated once at the end) and hand
+        its compact readback to the pipeline — or absorb it now when
+        ``sync`` (cold start, ring drain)."""
+        if self._pending_slide.any():
+            # a trailing slide with no votes recorded after it rides a
+            # synthetic empty slot, so the fused step still applies it
+            self._ring.append((
+                self._take_slide(),
+                self._stage_scatter([[] for _ in self._members],
+                                    self._resident_width),
+                0, [0] * self._n_shards))
+            self.flush_capacity_total += (
+                len(self._members) * self._resident_width)
+            self._account_shards([0] * self._n_shards,
+                                 self._resident_width)
+        # absorb the PREVIOUS consume first: its readback overlapped the
+        # resident ticks' host work
         self._sync_inflight()
-        deltas = np.zeros(self._m_pad, np.int32)
-        deltas[member_idx] = delta
-        # the plan's slide carries its own in_shardings (pjit on a mesh),
-        # so the raw host array places correctly without an explicit put
-        self._states = self._plan.slide(self._states, deltas)
+        if not self._ring:
+            results = self._dispatch_empty()  # cold start only
+        else:
+            slots, self._ring = self._ring, []
+            ticks, self._ring_ticks = self._ring_ticks, 0
+            slides = np.stack([
+                vec if vec is not None
+                else np.zeros(self._m_pad, np.int32)
+                for vec, _, _, _ in slots]).astype(np.int32)
+            args = None
+            if self.trace.enabled:
+                args = {"slots": len(slots), "ticks": ticks,
+                        "resident": self.resident_depth}
+            with self.trace.span("flush.dispatch", args=args) \
+                    if self.trace.enabled else _NO_SPAN:
+                step = resident_plan_for(
+                    self._mesh, self._n, self._n_pad, self._delta_cap,
+                    len(slots), self._resident_width)
+                self._states, events, compact = step(
+                    self._states, slides,
+                    *[words for _, words, _, _ in slots])
+            results = [(events, compact)]
+            self.flushes += 1
+            self.metrics.add_event(MetricsName.DEVICE_FLUSH)
+        self._inflight_cum = self._slide_cum.copy()
+        if self.pipelined and not sync:
+            for events, compact in results:
+                for arr in self._readback_arrays(events, compact):
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:  # noqa: BLE001 — backends without
+                        break  # async copy: device_get pays the trip
+            self._inflight = results
+            self._inflight_seq = self._flush_seq
+        else:
+            self._absorb_results(results, overlapped=False)
+
+    def _drain_ring(self) -> None:
+        """The residency barrier: consume + absorb everything device-
+        bound NOW. View resets, rebalance rotations and per-query
+        refreshes must observe (and mutate) fully-settled state —
+        correctness over residency."""
+        if self._resident and (self._ring or self._pending_slide.any()):
+            self._consume_ring(sync=True)
+        else:
+            self._sync_inflight()
+
+    def _flush_resident(self) -> None:
+        """The resident flush: enqueue this tick's votes into the ring;
+        dispatch the fused consume only when the ring holds
+        ``resident_depth`` ticks, the pool went quiet, or the snapshot
+        is void (cold start) — otherwise defer the readback and run the
+        tick entirely host-side."""
+        had_pending = any(m._pending for m in self._members)
+        if had_pending:
+            self._enqueue_chunks()
+        if self._host_prepared is None:
+            # cold start (or post-reset): callers need SOME snapshot
+            self._consume_ring(sync=True)
+            return
+        if self._ring and (self._ring_ticks >= self.resident_depth
+                           or not had_pending):
+            self._consume_ring()
+        elif self._ring:
+            self.readbacks_deferred += 1
+            self.metrics.add_event(MetricsName.DEVICE_READBACKS_DEFERRED)
+            if self.trace.enabled:
+                self.trace.record("flush.defer", cat="dispatch",
+                                  args={"ring_ticks": self._ring_ticks})
+        elif not had_pending and self._inflight is not None:
+            # quiet tick with nothing resident but a consume in flight:
+            # absorb now — residency must never stall verdict delivery
+            self._sync_inflight()
+
+    # --- occupancy-driven rebalancing ---------------------------------
+
+    def schedule_rebalance(self, rows: int) -> None:
+        """Plan a member-plane rotation by ``rows`` device rows along
+        mesh axis 0 (planes move, members don't). Executed at the next
+        checkpoint-boundary slide — the rebalance barrier, the only
+        instant the ring is guaranteed drained."""
+        rows = int(rows) % self._m_pad
+        if rows:
+            self._rebalance_pending = rows
+
+    def rebalance_at_barrier(self) -> None:
+        """Execute a scheduled rotation, if any. Called from the
+        checkpoint-boundary slide (and directly by harnesses that model
+        their own barriers)."""
+        if self._rebalance_pending:
+            self._execute_rebalance()
+
+    def _execute_rebalance(self) -> None:
+        from .rebalance import rotate_planes
+
+        rows, self._rebalance_pending = self._rebalance_pending, 0
+        # barrier: everything device-bound settles under the OLD
+        # placement (ring slots were staged against it), THEN the
+        # planes migrate and the placement map rewrites
+        self._drain_ring()
+        self._states = rotate_planes(self._states, self._mesh, rows,
+                                     self._shard_rows)
+        self._row_shift = (self._row_shift + rows) % self._m_pad
+        self._rebuild_placement()
+        self.rebalances += 1
         self.version += 1
-        self._host_prepared = None
-        # device-eval mirrors roll with the member's window (the device
-        # applied the identical roll/clamp in q.slide_state — prepared_acked
-        # rolled too, so surviving certs are NOT re-reported and the
-        # mirror must keep them)
+        if self.trace.enabled:
+            self.trace.record("rebalance.executed", cat="dispatch",
+                              args={"rows": rows,
+                                    "shift": self._row_shift})
+
+    def _roll_member_mirrors(self, member_idx: int, delta: int) -> None:
+        """Roll one member's host mirrors with its window (the device
+        applies the identical roll/clamp in q.slide_state —
+        prepared_acked rolled too, so surviving certs are NOT
+        re-reported and the mirror must keep them)."""
         mi, s = member_idx, self._log_size
         for mir in (self._mir_prepared[mi], self._mir_commit_ok[mi]):
             if delta < s:
@@ -1248,14 +1551,46 @@ class VotePlaneGroup:
         member._delta_committed = [
             x - delta for x in member._delta_committed if x >= delta]
 
+    def slide_member(self, member_idx: int, delta: int) -> None:
+        if self._resident:
+            # slide-fold: stage any votes recorded against the OLD
+            # window coordinates first (they must scatter before the
+            # slide), then just ACCUMULATE the delta — it rides the next
+            # ring slot as a fused-step operand. No sync, no dispatch,
+            # no snapshot void: the mirrors roll host-side and remain
+            # the live snapshot.
+            self._enqueue_chunks(count_tick=False)
+            self.rebalance_at_barrier()
+            self._pending_slide[self._row_of(member_idx)] += delta
+            self._slide_cum[member_idx] += delta
+            self._roll_member_mirrors(member_idx, delta)
+            self.version += 1
+            return
+        self.flush()
+        self._sync_inflight()
+        # checkpoint-boundary barrier: a scheduled rebalance rotates now,
+        # with the device state fully settled (timing-neutral — it adds
+        # no dispatches and changes no member-visible state)
+        self.rebalance_at_barrier()
+        deltas = np.zeros(self._m_pad, np.int32)
+        deltas[self._row_of(member_idx)] = delta
+        # the plan's slide carries its own in_shardings (pjit on a mesh),
+        # so the raw host array places correctly without an explicit put
+        self._states = self._plan.slide(self._states, deltas)
+        self.version += 1
+        self._host_prepared = None
+        self._roll_member_mirrors(member_idx, delta)
+
     def reset_member(self, member_idx: int) -> None:
         # pending for this member was cleared by the caller; other members'
-        # buffered votes are untouched (flushed on their next query)
-        self._sync_inflight()  # old-view events must not land post-reset
+        # buffered votes are untouched (flushed on their next query).
+        # View reset drains the residency ring synchronously — old-view
+        # events must not land post-reset (correctness over residency)
+        self._drain_ring()
         # the zero rides a member MASK on every plan: a dynamic row index
         # cannot address a shard-local block, a mask shards trivially
         mask = np.zeros(self._m_pad, np.uint8)
-        mask[member_idx] = 1
+        mask[self._row_of(member_idx)] = 1
         self._states = self._plan.zero(self._states, mask)
         self.version += 1
         self._host_prepared = None
@@ -1370,8 +1705,9 @@ class _MemberPlane(DeviceVotePlane):
         if not self.defer_flush_on_query:
             # per-query mode wants CURRENT state: a pipelined group must
             # absorb its in-flight step now, or the final batch's votes
-            # sit on-device forever with no tick driver to absorb them
-            self._group._sync_inflight()
+            # sit on-device forever with no tick driver to absorb them —
+            # and a resident group must consume its ring the same way
+            self._group._drain_ring()
         self._copy_slices()
 
     def events(self):
@@ -1429,4 +1765,6 @@ class _MemberPlane(DeviceVotePlane):
         if ev is None:
             return 0
         # one scalar fetched on demand from the device-resident events
-        return int(jax.device_get(ev.prepare_counts[self._mi, slot]))
+        # (row-addressed: the placement map translates under rotation)
+        return int(jax.device_get(
+            ev.prepare_counts[self._group._row_of(self._mi), slot]))

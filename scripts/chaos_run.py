@@ -63,6 +63,13 @@ def main() -> int:
                              "count (> 1 runs the laned path: faults "
                              "inside lane 0, cross_lane invariant "
                              "probed; 0 keeps the scenario's own value)")
+    parser.add_argument("--resident-depth", type=int, default=0,
+                        help="multi-tick device residency: votes "
+                             "accumulate in device-side ring slots over "
+                             "this many ticks before one fused step "
+                             "consumes them (requires --device-quorum "
+                             "and --tick; ordered output is bit-"
+                             "identical to the per-tick run)")
     parser.add_argument("--trace", action="store_true",
                         help="arm the consensus flight recorder: the "
                              "report gains trace_hash + flight_recorder "
@@ -76,6 +83,8 @@ def main() -> int:
         parser.error("--tick requires --device-quorum")
     if args.adaptive_tick and args.tick <= 0:
         parser.error("--adaptive-tick requires --tick")
+    if args.resident_depth > 1 and args.tick <= 0:
+        parser.error("--resident-depth requires --tick")
     mesh_shape = None
     if args.mesh not in ("0", 0):
         from indy_plenum_tpu.utils.jax_env import parse_mesh_shape
@@ -144,7 +153,8 @@ def main() -> int:
                           mesh=mesh,
                           trace=args.trace,
                           trace_out=(out + ".trace.jsonl"
-                                     if args.trace else None))
+                                     if args.trace else None),
+                          resident_depth=args.resident_depth)
     for line in report.summary_lines():
         print(line)
     print(f"  report: {out}")

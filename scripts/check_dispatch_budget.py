@@ -128,6 +128,20 @@ fabric models latency on a dedicated seeded RNG — it never touches the
 pool's RNG or timer), and two same-seed edge runs produce
 byte-identical records.
 
+Residency gate (PR 19): unless ``--no-residency-gate``, the script runs
+the n=16/k=6 workload per-tick vs with multi-tick device residency
+(``--residency-depth`` ring slots, votes accumulating on device across
+ticks before one fused consume) and fails if the ordered digests
+diverge, if the resident arm spends more than
+``--residency-dispatch-budget`` (1.0) device dispatches per ordered
+batch or never defers a readback, or if ordered/sim-second regresses
+beyond ``--residency-tolerance``. It also proves the occupancy-driven
+rebalance law: a synthetic 8:1 hot member block over threshold 2.0
+must plan a rotation whose predicted hottest block drops below the
+threshold, and a forced mid-run plane migration on the 4-way member
+mesh (executed at a checkpoint-boundary barrier) must keep the ordered
+digests bit-identical to the never-rebalanced arm.
+
 Running one gate: ``--only latency`` (or ``--only trace,latency``)
 replaces stacking nine ``--no-*-gate`` flags; ``--list-gates`` prints
 the names.
@@ -153,7 +167,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # gates will actually run: the 1-device budgets and governor gates are
 # calibrated on the unmodified topology and must keep measuring there.
 if ("--no-sharded-gate" not in sys.argv
-        or "--no-fabric-gate" not in sys.argv):
+        or "--no-fabric-gate" not in sys.argv
+        or "--no-residency-gate" not in sys.argv):
     from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
 
     _width = 4
@@ -198,18 +213,27 @@ def _submit_bursty(pool, target: int) -> None:
 def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
             tick_interval: float, seed: int = 11, adaptive: bool = False,
             bursty: bool = False, mesh=None, trace: bool = False,
-            host_eval: bool = False) -> dict:
+            host_eval: bool = False, resident_depth: int = 0,
+            overrides: "dict | None" = None) -> dict:
     """DELIBERATELY a cold run, unlike profile_rbft's warm-up-excluded
     measurement: the gate counts every dispatch from pool construction on
     (cold-start/compile steps included), because the budget protects the
     whole loop's dispatch discipline, not the steady-state ratio. Budgets
-    are calibrated with ~10x headroom over the cold numbers."""
-    config = getConfig({
+    are calibrated with ~10x headroom over the cold numbers.
+    ``resident_depth`` > 1 arms multi-tick device residency;
+    ``overrides`` layers extra config knobs (the residency gate forces a
+    rebalance with it) on top of the gate's shape."""
+    knobs = {
         "Max3PCBatchSize": batch_size,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": tick_interval,
         "QuorumTickAdaptive": adaptive,
-    })
+    }
+    if resident_depth > 1:
+        knobs["ResidentTickDepth"] = resident_depth
+    if overrides:
+        knobs.update(overrides)
+    config = getConfig(knobs)
     pool = SimPool(n_nodes=n_nodes, seed=seed, config=config,
                    device_quorum=True, shadow_check=False,
                    num_instances=instances, mesh=mesh, trace=trace,
@@ -272,6 +296,17 @@ def measure(n_nodes: int, instances: int, batches: int, batch_size: int,
         result["shards"] = pool.vote_group.shards
         result["mesh_shape"] = list(pool.vote_group.mesh_shape)
         result["shard_occupancy"] = pool.vote_group.shard_occupancy
+    vg = pool.vote_group
+    if vg.resident_depth > 1 or vg.rebalances:
+        # multi-tick residency / rebalancing surface: how many host
+        # round-trips the ring deferred and where the planes ended up
+        result["residency"] = {
+            "resident_depth": vg.resident_depth,
+            "resident_ticks": vg.resident_ticks,
+            "readbacks_deferred": vg.readbacks_deferred,
+            "rebalances": vg.rebalances,
+            "row_shift": vg.row_shift,
+        }
     if pool.governor is not None:
         result["governor"] = pool.governor.trajectory_summary()
     if trace:
@@ -1530,6 +1565,152 @@ def geo_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def residency_gate(args) -> "tuple[dict, list]":
+    """Multi-tick residency + rebalancing gate (ISSUE 19): on the SAME
+    n=16/k=6 workload and seed,
+
+    1. the resident arm (``--residency-depth`` ring slots) must order
+       digests bit-identical to the per-tick arm — residency changes
+       WHEN the host looks, never what the pool orders;
+    2. its device dispatches per ordered batch must sit under
+       ``--residency-dispatch-budget`` (1.0 — fewer than one fused
+       step per ordered batch, cold start included) AND the ring must
+       actually defer readbacks (a silently per-tick run is vacuous);
+    3. ordered/sim-second must stay within ``--residency-tolerance``
+       of the per-tick arm (generous: a short cold run quantizes sim
+       time to whole ticks, so deferring the final readback by one
+       tick legitimately moves the ratio);
+    4. the deterministic rebalance law must un-skew a synthetic hot
+       shard: skew 8:1 over 4 member blocks with threshold 2.0 plans a
+       sub-block rotation whose predicted hottest block drops below
+       the threshold;
+    5. a forced mid-run rebalance on a 4-way member mesh (plane
+       migration at a checkpoint-boundary barrier, host mirrors
+       rotated) must leave the ordered digests bit-identical to the
+       never-rebalanced same-seed arm.
+    """
+    from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
+    from indy_plenum_tpu.tpu.rebalance import RebalancePolicy
+
+    failures = []
+    per_tick = measure(args.sharded_nodes, args.sharded_instances,
+                       args.residency_batches, args.batch_size,
+                       args.tick, seed=args.seed)
+    resident = measure(args.sharded_nodes, args.sharded_instances,
+                       args.residency_batches, args.batch_size,
+                       args.tick, seed=args.seed,
+                       resident_depth=args.residency_depth)
+    if resident["ordered_hash"] != per_tick["ordered_hash"]:
+        failures.append("resident ordered digests diverge from the "
+                        "per-tick run (residency changed semantics)")
+    r_pb = resident["device_dispatches_per_ordered_batch"]
+    if r_pb > args.residency_dispatch_budget:
+        failures.append(
+            f"resident dispatches/batch {r_pb} over budget "
+            f"{args.residency_dispatch_budget}")
+    res = resident.get("residency") or {}
+    if not res.get("readbacks_deferred"):
+        failures.append("resident arm deferred no readbacks — the ring "
+                        "silently ran per-tick (gate vacuous)")
+    tol = args.residency_tolerance
+    p_tps = per_tick["ordered_per_sim_second"] or 0.0
+    r_tps = resident["ordered_per_sim_second"] or 0.0
+    if r_tps < p_tps * (1.0 - tol):
+        failures.append(f"resident ordered/sim-sec {r_tps} regresses "
+                        f"per-tick {p_tps} beyond {tol:.0%}")
+
+    # the deterministic un-skew law on a synthetic hot shard: one block
+    # 8x hotter than the rest must plan a sub-block rotation that
+    # splits its heat below the threshold
+    policy = RebalancePolicy(m_shards=4, shard_rows=2, threshold=2.0,
+                             dwell=2)
+    hot = [8.0, 1.0, 1.0, 1.0]
+    rows = 0
+    for _ in range(policy.dwell + 1):
+        rows = policy.observe(hot)
+        if rows:
+            break
+    pre_skew = policy.skew(policy.block_heat(hot))
+    post_heat = _predicted_heat(policy.block_heat(hot), rows,
+                                policy.shard_rows)
+    post_skew = policy.skew(post_heat)
+    if not rows:
+        failures.append(f"skew {pre_skew:.2f} over threshold "
+                        f"{policy.threshold} never planned a rotation")
+    elif post_skew >= min(pre_skew, policy.threshold):
+        failures.append(
+            f"planned rotation ({rows} rows) does not un-skew the hot "
+            f"shard: predicted skew {post_skew:.2f} (pre {pre_skew:.2f},"
+            f" threshold {policy.threshold})")
+
+    # forced plane migration mid-run on the 4-way member mesh: the
+    # barrier drains the ring, the planes rotate, the host placement
+    # map rewrites — and the ordering must not notice
+    devices = jax.devices()
+    if len(devices) < 4:
+        failures.append("residency gate needs 4 host devices for the "
+                        f"rebalance arm (have {len(devices)})")
+        rebalanced = baseline = {"skipped": "needs 4 devices"}
+    else:
+        mesh = make_fabric_mesh(devices, (4,))
+        window = {"CHK_FREQ": 5, "LOG_SIZE": 15}
+        baseline = measure(8, 2, args.residency_batches,
+                           args.batch_size, args.tick, seed=args.seed,
+                           mesh=mesh, resident_depth=args.residency_depth,
+                           overrides=window)
+        rebalanced = measure(8, 2, args.residency_batches,
+                             args.batch_size, args.tick, seed=args.seed,
+                             mesh=mesh,
+                             resident_depth=args.residency_depth,
+                             overrides={**window,
+                                        "RebalanceForceTick": 12})
+        moved = rebalanced.get("residency") or {}
+        if not moved.get("rebalances"):
+            failures.append("forced rebalance never executed (no "
+                            "checkpoint barrier reached, or the policy "
+                            "never planned)")
+        elif not moved.get("row_shift"):
+            failures.append("rebalance executed but the placement map "
+                            "never rotated")
+        if rebalanced["ordered_hash"] != baseline["ordered_hash"]:
+            failures.append("rebalanced ordered digests diverge from "
+                            "the never-rebalanced arm (plane migration "
+                            "changed semantics)")
+
+    record = {
+        "per_tick": per_tick,
+        "resident": resident,
+        "residency_depth": args.residency_depth,
+        "residency_dispatch_budget": args.residency_dispatch_budget,
+        "residency_tolerance": tol,
+        "digests_match":
+            resident["ordered_hash"] == per_tick["ordered_hash"],
+        "dispatch_ratio": round(
+            r_pb / per_tick["device_dispatches_per_ordered_batch"], 3)
+        if per_tick["device_dispatches_per_ordered_batch"] else None,
+        "unskew_law": {
+            "planned_rows": rows,
+            "pre_skew": round(pre_skew, 3),
+            "predicted_post_skew": round(post_skew, 3),
+            "threshold": policy.threshold,
+        },
+        "rebalance_baseline": baseline,
+        "rebalance_forced": rebalanced,
+    }
+    return record, failures
+
+
+def _predicted_heat(heat, rows, shard_rows):
+    """The policy's own placement model: rotating by ``rows`` device
+    rows splits each block's load proportionally between the blocks
+    its rows land on."""
+    n_blocks = len(heat)
+    b0, r = divmod(rows, shard_rows)
+    return [(shard_rows - r) / shard_rows * heat[(k - b0) % n_blocks]
+            + r / shard_rows * heat[(k - b0 - 1) % n_blocks]
+            for k in range(n_blocks)]
+
+
 # gate registry (--list-gates / --only): name -> (argparse dest of the
 # skip flag, one-line description). The core dispatch-budget measurement
 # always runs — it is the baseline every budget compares against.
@@ -1565,6 +1746,11 @@ GATES = {
             "planet-scale read fabric: >=90% edge-local reads at intra "
             "p99 vs same-seed WAN baseline, zero serve-path pairings, "
             "bit-identical write fingerprints, deterministic replay"),
+    "residency": ("no_residency_gate",
+                  "multi-tick device residency + rebalancing: per-tick "
+                  "digest identity, <=1 dispatch/ordered batch, "
+                  "synthetic un-skew law, forced plane migration with "
+                  "unchanged digests"),
 }
 
 
@@ -1662,6 +1848,24 @@ def main() -> int:
                          "the same-seed WAN baseline, zero serve-path "
                          "pairings, bit-identical write fingerprints "
                          "between arms, byte-identical replay)")
+    ap.add_argument("--no-residency-gate", action="store_true",
+                    help="skip the multi-tick residency + rebalancing "
+                         "gate (per-tick digest identity, dispatch "
+                         "budget, un-skew law, forced plane migration)")
+    ap.add_argument("--residency-depth", type=int, default=4,
+                    help="ring depth for the resident arm")
+    ap.add_argument("--residency-batches", type=int, default=6,
+                    help="ordered batches per residency-gate arm (long "
+                         "enough to amortize the cold-start consumes "
+                         "the gate deliberately counts)")
+    ap.add_argument("--residency-dispatch-budget", type=float,
+                    default=1.0,
+                    help="max device dispatches per ordered batch on "
+                         "the resident arm (cold run, n=16/k=6)")
+    ap.add_argument("--residency-tolerance", type=float, default=0.5,
+                    help="allowed resident ordered/sim-second slack vs "
+                         "the per-tick arm (generous: short cold runs "
+                         "quantize sim time to whole ticks)")
     ap.add_argument("--geo-hit-floor", type=float, default=0.90,
                     help="min fraction of storm reads the edge arm "
                          "must serve from region-local edge caches")
@@ -1823,6 +2027,10 @@ def main() -> int:
     if not args.no_geo_gate:
         record, failures = geo_gate(args)
         result["geo_gate"] = record
+        over.extend(failures)
+    if not args.no_residency_gate:
+        record, failures = residency_gate(args)
+        result["residency_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

@@ -80,13 +80,15 @@ BATCH = 160
 
 
 def _build_pool(n, k, tick_interval, adaptive=False, mesh=None,
-                trace=False, ingress_capacity=0, real_execution=False):
+                trace=False, ingress_capacity=0, real_execution=False,
+                resident_depth=0):
     config = getConfig({
         "Max3PCBatchSize": BATCH,
         "Max3PCBatchWait": 0.05,
         "QuorumTickInterval": tick_interval,
         "QuorumTickAdaptive": adaptive,
         "IngressQueueCapacity": ingress_capacity,
+        "ResidentTickDepth": max(resident_depth, 1),
     })
     # a bounded ingress queue only means something on the signed auth
     # path (the admission plane guards the device auth batch)
@@ -194,6 +196,11 @@ def main():
                          "plane): the --json record's state block "
                          "carries hashes/commit, node-cache hit rate "
                          "and offload mode")
+    ap.add_argument("--resident-depth", type=int, default=0,
+                    help="multi-tick device residency: accumulate votes "
+                         "in device-side ring slots over this many ticks "
+                         "before one fused step consumes them; the "
+                         "--json record gains a residency block")
     ap.add_argument("--trace", action="store_true",
                     help="arm the consensus flight recorder: dumps the "
                          "span trace as JSONL (--trace-out) and the "
@@ -226,7 +233,8 @@ def main():
                        adaptive=not args.static_tick, mesh=mesh,
                        trace=args.trace,
                        ingress_capacity=args.ingress_capacity,
-                       real_execution=args.real_execution)
+                       real_execution=args.real_execution,
+                       resident_depth=args.resident_depth)
     got, elapsed, dispatches, prof = _run(pool, txns, profile=True)
     print(f"n={n} k={k}: {got}/{txns} ordered in {elapsed:.2f}s "
           f"= {got / elapsed:.1f} txns/sec", file=sys.stderr)
@@ -292,6 +300,13 @@ def main():
             MetricsName.GOVERNOR_TICK_INTERVAL),
         "governor": (pool.governor.trajectory_summary()
                      if pool.governor is not None else None),
+        # multi-tick residency: how much host round-tripping the ring
+        # actually saved (None when the run was per-tick)
+        "residency": ({
+            "resident_depth": pool.vote_group.resident_depth,
+            "resident_ticks": pool.vote_group.resident_ticks,
+            "readbacks_deferred": pool.vote_group.readbacks_deferred,
+        } if pool.vote_group.resident_depth > 1 else None),
         "hotspots_top20_cumulative": _hotspots(prof),
     }
     # ingress plane: the admission queue's depth/admitted/shed and the

@@ -293,13 +293,34 @@ def main() -> int:
               f"readbacks overlapped a full tick of host work; "
               f"readback bytes/tick p50={bpt['p50']} max={bpt['max']} "
               f"(total {ov['readback_bytes_total']})")
+        resident = "residency" in ov
+        if resident:
+            rs = ov["residency"]
+            print(f"residency: {rs['enqueues']} enqueues over "
+                  f"{rs['resident_ticks_total']} resident ticks, "
+                  f"{rs['readbacks_deferred']} readbacks deferred")
+        if "rebalances" in ov:
+            rb = ov["rebalances"]
+            print(f"rebalances: {rb['executed']} executed")
+            for m in rb["marks"]:
+                print(f"  t={m['ts']:.6f} {m['name']} {m['args']}")
         if args.overlap:
-            print(f"  {'tick_ts':>14s} {'dispatches':>10s} {'votes':>7s} "
-                  f"{'readbacks':>9s} {'overlapped':>10s} {'rb_bytes':>9s}")
+            cols = (f"  {'tick_ts':>14s} {'dispatches':>10s} "
+                    f"{'votes':>7s} {'readbacks':>9s} {'overlapped':>10s} "
+                    f"{'rb_bytes':>9s}")
+            if resident:
+                cols += (f" {'enqueues':>8s} {'res_ticks':>9s} "
+                         f"{'deferred':>8s}")
+            print(cols)
             for t in ov["per_tick"]:
-                print(f"  {t.get('ts', 0):>14.6f} {t['dispatches']:>10d} "
-                      f"{t['votes']:>7d} {t['readbacks']:>9d} "
-                      f"{t['overlapped']:>10d} {t['readback_bytes']:>9d}")
+                row = (f"  {t.get('ts', 0):>14.6f} {t['dispatches']:>10d} "
+                       f"{t['votes']:>7d} {t['readbacks']:>9d} "
+                       f"{t['overlapped']:>10d} {t['readback_bytes']:>9d}")
+                if resident:
+                    row += (f" {t.get('enqueues', 0):>8d} "
+                            f"{t.get('resident_ticks', 0):>9d} "
+                            f"{t.get('deferred', 0):>8d}")
+                print(row)
         if "per_shard" in ov:
             ps = ov["per_shard"]
             print("per-shard (scale-out quorum fabric; a hot shard is "

@@ -1,0 +1,433 @@
+"""Multi-tick device residency + occupancy-driven rebalancing (PR 19).
+
+Contract under test (README "Multi-tick device residency &
+rebalancing"): with ``ResidentTickDepth`` N > 1 the grouped vote plane
+accumulates up to N ticks of votes in device-side ring slots and
+consumes them with ONE fused step (checkpoint slides folded in per
+slot) — a placement/scheduling choice, so ordering must stay
+bit-identical to the per-tick plane on the same seed, through view
+changes, window slides and forced rebalances. The rebalance law
+(tpu/rebalance.py) is a pure deterministic fold over the governor's
+occupancy EWMAs; rotations execute only at the checkpoint-boundary
+barrier where the ring is guaranteed drained.
+
+The heavyweight chaos arm rides the slow lane; the n=16/k=6 dispatch
+budget comparison lives in scripts/check_dispatch_budget.py's residency
+gate.
+"""
+import os
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+np = pytest.importorskip("numpy")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from indy_plenum_tpu.config import getConfig  # noqa: E402
+from indy_plenum_tpu.simulation.pool import SimPool  # noqa: E402
+from indy_plenum_tpu.tpu.rebalance import RebalancePolicy  # noqa: E402
+
+
+def _mesh(devices, n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:n]), ("members",))
+
+
+def _run_pool(n_nodes, k, seed, mesh, overrides=None, view_change=True,
+              trace=False):
+    """Order a workload (optionally through a view change) and return the
+    surviving nodes' digest map plus the pool."""
+    knobs = {"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+             "QuorumTickInterval": 0.05, "QuorumTickAdaptive": True}
+    knobs.update(overrides or {})
+    cfg = getConfig(knobs)
+    pool = SimPool(n_nodes, seed=seed, config=cfg, device_quorum=True,
+                   shadow_check=False, num_instances=k, mesh=mesh,
+                   trace=trace)
+    primary = pool.nodes[0].data.primaries[0]
+    for i in range(6):
+        pool.submit_request(i)
+    pool.run_for(8)
+    if view_change:
+        pool.network.disconnect(primary)
+        pool.run_for(pool.config.ToleratePrimaryDisconnection + 10)
+        for i in range(100, 104):
+            pool.submit_request(i)
+        pool.run_for(12)
+    assert pool.honest_nodes_agree()
+    digests = {n.name: tuple(n.ordered_digests) for n in pool.nodes
+               if not view_change or n.name != primary}
+    return digests, pool
+
+
+# ---------------------------------------------------------------------
+# tier-1: residency is a scheduling choice — bit-identical ordering
+# ---------------------------------------------------------------------
+
+@pytest.mark.perf
+def test_resident_digest_identity_incl_view_change(eight_devices):
+    """Depth-4 residency vs per-tick on the same seed (n=8/k=2, 4-way
+    mesh, adaptive tick) through a view change: bit-identical ordered
+    digests, and the ring really deferred readbacks (non-vacuity)."""
+    mesh = _mesh(eight_devices, 4)
+    resident, rpool = _run_pool(
+        8, 2, seed=37, mesh=mesh, overrides={"ResidentTickDepth": 4})
+    per_tick, _ = _run_pool(8, 2, seed=37, mesh=mesh)
+    assert resident == per_tick
+    g = rpool.vote_group
+    assert g.resident_depth == 4
+    assert g.resident_ticks > 0, "ring never accumulated a tick"
+    assert g.readbacks_deferred > 0, "ring never deferred a readback"
+
+
+def test_resident_slide_fold_identity(eight_devices):
+    """Checkpoint slides FOLD into the resident step: a window-sliding
+    workload (CHK_FREQ 5) orders bit-identically at depth 4, the window
+    really slid, and every plane's h tracks its member's low
+    watermark."""
+    overrides = {"Max3PCBatchSize": 1, "CHK_FREQ": 5, "LOG_SIZE": 15}
+
+    def run(depth):
+        cfg = getConfig({"Max3PCBatchWait": 0.1,
+                         "QuorumTickInterval": 0.05,
+                         "QuorumTickAdaptive": True,
+                         "ResidentTickDepth": depth, **overrides})
+        pool = SimPool(4, seed=11, config=cfg, device_quorum=True,
+                       shadow_check=False)
+        for i in range(12):
+            pool.submit_request(i)
+        pool.run_for(30)
+        assert pool.honest_nodes_agree()
+        return pool
+
+    resident = run(4)
+    per_tick = run(1)
+    assert resident.ordered_hash() == per_tick.ordered_hash()
+    for node in resident.nodes:
+        assert node.data.stable_checkpoint >= 10
+        assert node.vote_plane.h == node.data.low_watermark
+    g = resident.vote_group
+    assert g.readbacks_deferred > 0
+    assert g.flushes < per_tick.vote_group.flushes, \
+        (g.flushes, per_tick.vote_group.flushes)
+
+
+def test_ring_drains_on_view_reset():
+    """The residency barrier: a member reset must observe fully-settled
+    state, so a non-empty ring drains synchronously — and ``lagging``
+    covers resident-but-unread slots (the governor's absorb clamp
+    input)."""
+    from indy_plenum_tpu.tpu.vote_plane import VotePlaneGroup
+
+    validators = [f"n{i}" for i in range(4)]
+    group = VotePlaneGroup(4, validators, log_size=8, n_checkpoints=2,
+                           resident_depth=4)
+    # cold start: first flush consumes synchronously (callers need SOME
+    # snapshot), leaving a live host snapshot behind
+    group.view(0).record_preprepare(1)
+    group.view(0).record_prepare("n1", 1)
+    group.flush()
+    assert not group._ring
+    # second tick enqueues and DEFERS (ring_ticks 1 < depth 4)
+    group.view(1).record_prepare("n0", 2)
+    group.view(1).record_prepare("n2", 2)
+    group.flush()
+    assert group._ring, "tick should have enqueued a ring slot"
+    assert group.readbacks_deferred == 1
+    assert group.lagging  # resident slots count as in-flight work
+    # view reset of ANY member drains the whole ring first
+    group.reset_member(3)
+    assert not group._ring
+    assert not group._pending_slide.any()
+    assert not group.lagging
+    # the drained slot's votes are visible, the reset member's are gone
+    assert group.view(1).prepare_count(2) == 2
+    assert group.view(0).prepare_count(1) == 1
+
+
+# ---------------------------------------------------------------------
+# tier-1: forced rebalance is a placement choice — bit-identical too
+# ---------------------------------------------------------------------
+
+def _run_rebalance_arm(n_nodes, seed, mesh, force_tick, depth=4):
+    """Fixed-tick sliding workload; rotation forced at ``force_tick``
+    executes at the next checkpoint barrier."""
+    cfg = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 1,
+                     "QuorumTickInterval": 0.05,
+                     "CHK_FREQ": 5, "LOG_SIZE": 15,
+                     "ResidentTickDepth": depth,
+                     "RebalanceForceTick": force_tick})
+    pool = SimPool(n_nodes, seed=seed, config=cfg, device_quorum=True,
+                   shadow_check=False, mesh=mesh, trace=True)
+    for i in range(6):
+        pool.submit_request(i)
+    pool.run_for(5)
+    for i in range(6, 12):
+        pool.submit_request(i)
+    pool.run_for(25)
+    assert pool.honest_nodes_agree()
+    return pool
+
+
+@pytest.mark.parametrize("shape", [(4,), (2, 2)])
+def test_forced_rebalance_digest_identity(eight_devices, shape):
+    """A forced mid-run member-plane rotation (1-axis and 2-axis
+    fabric): ordered_hash AND trace_hash(exclude_cats={'dispatch'})
+    bit-identical to the never-rebalanced arm — only the dispatch
+    timeline may differ."""
+    from indy_plenum_tpu.tpu.quorum import make_fabric_mesh
+
+    mesh = (_mesh(eight_devices, shape[0]) if len(shape) == 1
+            else make_fabric_mesh(eight_devices, shape))
+    forced = _run_rebalance_arm(8, seed=23, mesh=mesh, force_tick=12)
+    baseline = _run_rebalance_arm(8, seed=23, mesh=mesh, force_tick=0)
+    g = forced.vote_group
+    assert g.rebalances >= 1, "forced rotation never executed"
+    assert g.row_shift != 0
+    assert baseline.vote_group.rebalances == 0
+    assert forced.ordered_hash() == baseline.ordered_hash()
+    assert (forced.trace.trace_hash(exclude_cats=("dispatch",))
+            == baseline.trace.trace_hash(exclude_cats=("dispatch",)))
+    # the migration landed in the trace's dispatch timeline
+    names = [ev["name"] for ev in forced.trace.events()]
+    assert "rebalance.planned" in names
+    assert "rebalance.executed" in names
+
+
+# ---------------------------------------------------------------------
+# tier-1: the rebalance law (pure fold — unit-testable without jax run)
+# ---------------------------------------------------------------------
+
+def test_rebalance_skew_even_count_median():
+    """Hottest/median with an even block count takes the mean of the
+    middle two."""
+    assert RebalancePolicy.skew([8.0, 1.0, 1.0, 1.0]) == 8.0
+    assert RebalancePolicy.skew([4.0, 2.0]) == pytest.approx(4.0 / 3.0)
+    assert RebalancePolicy.skew([1.0, 1.0, 1.0]) == 1.0
+
+
+def test_rebalance_dwell_counting_and_reset():
+    """The skew must hold above threshold for DWELL consecutive ticks;
+    a single dip re-arms the counter."""
+    hot = [8.0, 1.0, 1.0, 1.0]
+    cool = [1.0, 1.0, 1.0, 1.0]
+    p = RebalancePolicy(4, 2, threshold=2.0, dwell=3)
+    assert p.observe(hot) == 0
+    assert p.observe(hot) == 0
+    assert p.observe(cool) == 0  # dip resets the dwell counter
+    assert p.observe(hot) == 0
+    assert p.observe(hot) == 0
+    rows = p.observe(hot)  # third consecutive over-threshold tick
+    assert rows > 0 and p.planned == 1
+    assert p.last_skew == 8.0
+
+
+def test_rebalance_cooldown_mutes_the_law():
+    """After a plan the law mutes while post-rotation EWMAs re-learn —
+    the stale transient must not immediately re-trigger."""
+    hot = [8.0, 1.0, 1.0, 1.0]
+    p = RebalancePolicy(4, 2, threshold=2.0, dwell=2, cooldown=5)
+    assert [p.observe(hot) for _ in range(2)][-1] > 0
+    assert all(p.observe(hot) == 0 for _ in range(5))  # muted
+    # re-armed after the cooldown window
+    out = [p.observe(hot) for _ in range(2)]
+    assert out[-1] > 0 and p.planned == 2
+
+
+def test_rebalance_plan_minimizes_predicted_hot_block():
+    """Row-granular rotation: heat [8,1,1,1] on 2-row blocks splits the
+    hot block across two neighbours — one row (predicted hottest 4.5)
+    beats any whole-block shift (which is heat-invariant), and the
+    smallest winning shift ties-break."""
+    p = RebalancePolicy(4, 2)
+    assert p.plan([8.0, 1.0, 1.0, 1.0]) == 1
+    # perfectly flat heat: no rotation strictly improves — plan 0
+    assert p.plan([3.0, 3.0, 3.0, 3.0]) == 0
+    # whole-block shifts alone never help: with 1-row blocks every
+    # rotation is whole-block, so the plan stays 0
+    assert RebalancePolicy(4, 1).plan([8.0, 1.0, 1.0, 1.0]) == 0
+
+
+def test_rebalance_policy_determinism():
+    """Same observation series, same plans — the law is a pure fold."""
+    rng = np.random.RandomState(5)
+    series = [list(rng.uniform(0.0, 8.0, size=4)) for _ in range(64)]
+    a = RebalancePolicy(4, 2, threshold=1.5, dwell=3)
+    b = RebalancePolicy(4, 2, threshold=1.5, dwell=3)
+    plans_a = [a.observe(s) for s in series]
+    plans_b = [b.observe(s) for s in series]
+    assert plans_a == plans_b
+    assert a.last_skew == b.last_skew and a.planned == b.planned
+
+
+def test_rebalance_from_config_gating():
+    """Composition root: None unless member-sharded AND a trigger armed
+    — the common path pays nothing."""
+    class FakeGroup:
+        _m_shards = 4
+        _shard_rows = 2
+        _v_shards = 1
+
+    armed = getConfig({"RebalanceSkewThreshold": 2.0})
+    assert RebalancePolicy.from_config(armed, None) is None
+    unarmed = getConfig({})
+    assert RebalancePolicy.from_config(unarmed, FakeGroup()) is None
+    policy = RebalancePolicy.from_config(armed, FakeGroup())
+    assert policy is not None and policy.threshold == 2.0
+    assert policy.dwell == armed.RebalanceDwellTicks
+    forced = getConfig({"RebalanceForceTick": 7})
+    assert RebalancePolicy.from_config(forced, FakeGroup()) is not None
+
+
+def test_rebalance_forced_rotation_unskews_hot_block():
+    """The gate's un-skew law: rotating the planned rows really lowers
+    the predicted hottest/median skew below threshold."""
+    p = RebalancePolicy(4, 2, threshold=2.0, dwell=2)
+    hot = [8.0, 1.0, 1.0, 1.0]
+    rows = 0
+    for _ in range(4):
+        rows = rows or p.observe(hot)
+    assert rows == 1
+    b0, r = divmod(rows, 2)
+    predicted = [
+        (2 - r) / 2 * hot[(k - b0) % 4] + r / 2 * hot[(k - b0 - 1) % 4]
+        for k in range(4)]
+    assert RebalancePolicy.skew(predicted) < min(
+        RebalancePolicy.skew(hot), p.threshold)
+
+
+# ---------------------------------------------------------------------
+# tier-1: zero-residency runs stay bit-identical (governor included)
+# ---------------------------------------------------------------------
+
+def test_zero_residency_bit_identical_governor_trajectory(eight_devices):
+    """Depth 1 (the default) is byte-for-byte the pre-residency plane:
+    same ordering, same governor EWMA trajectory, no ring counters."""
+    mesh = _mesh(eight_devices, 4)
+    explicit, ep = _run_pool(8, 2, seed=41, mesh=mesh, view_change=False,
+                             overrides={"ResidentTickDepth": 1})
+    default, dp = _run_pool(8, 2, seed=41, mesh=mesh, view_change=False)
+    assert explicit == default
+    assert ep.governor is not None
+    assert ep.governor.trajectory_summary() \
+        == dp.governor.trajectory_summary()
+    assert ep.governor.shard_ewmas == dp.governor.shard_ewmas
+    g = ep.vote_group
+    assert g.resident_depth == 1
+    assert g.resident_ticks == 0 and g.readbacks_deferred == 0
+
+
+def test_monitor_snapshot_residency_block():
+    """Monitor.snapshot()'s device_dispatch block carries the residency
+    counters when a ring ran — and stays byte-compatible (no block)
+    at depth 1."""
+    from indy_plenum_tpu.simulation.node_pool import NodePool
+
+    def run(depth):
+        config = getConfig({"Max3PCBatchWait": 0.1, "Max3PCBatchSize": 5,
+                            "PropagateBatchWait": 0.05,
+                            "QuorumTickInterval": 0.05,
+                            "QuorumTickAdaptive": True,
+                            "ResidentTickDepth": depth})
+        pool = NodePool(4, seed=81, config=config, device_quorum=True)
+        for _ in range(3):
+            pool.submit_to("node0", pool.make_nym_request())
+        pool.run_for(15)
+        assert all(len(n.ordered_digests) == 3 for n in pool.nodes)
+        return pool.node("node0").monitor.snapshot()["device_dispatch"]
+
+    resident = run(4)
+    assert resident["residency"]["resident_depth"] == 4
+    assert resident["residency"]["resident_ticks"] > 0
+    assert resident["residency"]["readbacks_deferred"] >= 0
+    assert "residency" not in run(1)
+
+
+# ---------------------------------------------------------------------
+# tier-1: observability + CLI surfaces (no jax run needed)
+# ---------------------------------------------------------------------
+
+def test_overlap_report_residency_and_rebalance_marks():
+    """overlap_report folds the resident event shapes: enqueues carry
+    the votes, the fused dispatch carries the consumed tick count,
+    defers count, and rebalance marks surface with their args."""
+    from indy_plenum_tpu.observability.trace import overlap_report
+
+    events = [
+        {"name": "flush.enqueue", "cat": "dispatch", "ts": 0.0,
+         "args": {"votes": 4, "shape": 16}},
+        {"name": "flush.defer", "cat": "dispatch", "ts": 0.01,
+         "args": {"ring_ticks": 1}},
+        {"name": "tick.flush", "cat": "dispatch", "ts": 0.05, "args": {}},
+        {"name": "flush.enqueue", "cat": "dispatch", "ts": 0.1,
+         "args": {"votes": 2, "shape": 16}},
+        {"name": "rebalance.planned", "cat": "dispatch", "ts": 0.11,
+         "args": {"rows": 1, "skew": 8.0}},
+        {"name": "flush.dispatch", "cat": "dispatch", "ts": 0.12,
+         "args": {"slots": 2, "ticks": 2, "resident": 4}},
+        {"name": "rebalance.executed", "cat": "dispatch", "ts": 0.13,
+         "args": {"rows": 1, "shift": 1}},
+        {"name": "flush.readback", "cat": "dispatch", "ts": 0.14,
+         "args": {"bytes": 100, "overlapped": True}},
+        {"name": "tick.flush", "cat": "dispatch", "ts": 0.15, "args": {}},
+    ]
+    report = overlap_report(events)
+    assert report["ticks"] == 2
+    res = report["residency"]
+    assert res["enqueues"] == 2
+    assert res["resident_ticks_total"] == 2
+    assert res["readbacks_deferred"] == 1
+    reb = report["rebalances"]
+    assert reb["executed"] == 1
+    assert [m["name"] for m in reb["marks"]] \
+        == ["rebalance.planned", "rebalance.executed"]
+    # enqueued votes land on the tick rows (not double-counted by the
+    # fused dispatch, which carries no votes key)
+    assert [t["votes"] for t in report["per_tick"]] == [4, 2]
+    assert [t["enqueues"] for t in report["per_tick"]] == [1, 1]
+    # non-resident dumps keep the old shape: no residency block at all
+    flat = [
+        {"name": "flush.dispatch", "cat": "dispatch", "ts": 0.0,
+         "args": {"votes": 6, "shape": 16}},
+        {"name": "tick.flush", "cat": "dispatch", "ts": 0.1, "args": {}},
+    ]
+    out = overlap_report(flat)
+    assert "residency" not in out and "rebalances" not in out
+
+
+def test_chaos_runner_validates_resident_depth():
+    """resident_depth > 1 needs the tick-batched device plane — the
+    runner rejects unsupported combinations up front."""
+    from indy_plenum_tpu.chaos.runner import run_scenario
+
+    with pytest.raises(ValueError):
+        run_scenario("f_crash_partition", seed=3, resident_depth=4)
+    with pytest.raises(ValueError):
+        run_scenario("f_crash_partition", seed=3, device_quorum=True,
+                     quorum_tick_interval=0.1, host_eval=True,
+                     resident_depth=4)
+
+
+# ---------------------------------------------------------------------
+# slow lane: chaos under residency
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_f_crash_partition_under_residency():
+    """The acceptance chaos scenario through a depth-4 ring: every
+    invariant PASSes and the replay command reproduces the depth."""
+    from indy_plenum_tpu.chaos.runner import run_scenario
+
+    report = run_scenario("f_crash_partition", seed=7,
+                          device_quorum=True,
+                          quorum_tick_interval=0.1,
+                          quorum_tick_adaptive=True,
+                          resident_depth=4)
+    assert report.failed == [], report.invariants
+    assert report.verdict_as_expected
+    assert "--resident-depth 4" in report.replay_command
+    assert report.dispatch_mode["resident"] == 4

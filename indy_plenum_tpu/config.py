@@ -312,6 +312,43 @@ class Config:
     # keeps per-wave latency stats representative without drowning the
     # ring
     TraceNetReceivers: int = 0
+    # long-horizon telemetry plane (observability/telemetry.py): windowed
+    # rollups + resource ledger + drift laws on the virtual clock. 0 =
+    # unarmed (no ledger, no plane, zero cost — the pre-telemetry pool).
+    # Armed, the pool registers every bounded structure in one
+    # ResourceLedger and rolls a time-series row every window, with the
+    # running telemetry_hash chain byte-identical per seed.
+    TelemetryWindowSec: float = 0.0
+    # rollup rows the plane retains (the hash chain keeps fingerprinting
+    # evicted rows with O(1) state, like the lane barrier's seal chain)
+    TelemetryWindowKeep: int = 64
+    # leak law: window high-water strictly increasing for this many
+    # consecutive windows fires one anomaly per episode
+    TelemetryLeakWindows: int = 4
+    # windows exempt from the leak/creep laws while caches warm toward
+    # their steady state (rings filling to capacity is not a leak)
+    TelemetryLeakGraceWindows: int = 6
+    # throughput law: ordered delta dropping by more than this fraction
+    # against the window TelemetryDriftLag back is drift; set the lag to
+    # profile-period/window so a diurnal trough compares to the same
+    # phase a cycle earlier instead of reading as degradation
+    TelemetryDriftFrac: float = 0.5
+    TelemetryDriftLag: int = 1
+    # anomaly records retained (total count and hash chain keep going)
+    TelemetryAnomalyKeep: int = 32
+
+    # --- virtual-day soak (simulation/soak.py) ----------------------------
+    # the composed long-horizon arc: a diurnal day of real-execution
+    # ordering with telemetry armed and chaos folded in — a GC-crossing
+    # crash/catchup, a primary view change, and a forced shard rebalance.
+    # Hours are offsets into the measured day (0 = that leg disabled).
+    SoakHours: float = 24.0
+    SoakRate: float = 0.1  # base writes/sec before the diurnal profile
+    SoakKeys: int = 400  # distinct state keys the workload cycles over
+    SoakCrashHour: float = 6.0  # non-primary crash (GC-crossing catchup)
+    SoakCrashHours: float = 1.0  # outage length, in hours
+    SoakViewChangeHour: float = 12.0  # primary partition -> view change
+    SoakRebalanceTick: int = 5000  # RebalanceForceTick for the soak pool
     # logging (reference: stp logging config + rotating handler); the
     # five knobs below are consumed by scripts/start_node.py (deployed
     # logging setup), outside the package the analyzer walks

@@ -139,6 +139,18 @@ class LedgerBacking:
             bus.subscribe(CheckpointStabilized,
                           self._on_checkpoint_stabilized)
 
+    def sized_resources(self, prefix: str = "read_backing."):
+        """Resource-ledger registration (observability.telemetry): the
+        audit-path LRU is the backing's one bounded store."""
+        from ..observability.telemetry import SizedResource
+
+        return (
+            SizedResource(prefix + "path_cache",
+                          lambda: len(self._path_cache),
+                          bound=self._path_cache_max or None,
+                          entry_bytes=680),
+        )
+
     def _on_checkpoint_stabilized(self, msg, *args) -> None:
         self.refresh()
 

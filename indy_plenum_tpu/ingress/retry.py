@@ -108,6 +108,22 @@ class RetryDriver:
         # re-offer actually scheduled
         self._records: List[str] = []
 
+    def sized_resources(self, prefix: str = "retry."):
+        """Resource-ledger registration (observability.telemetry): the
+        live cohort (attempt counters + scheduled re-offers). The
+        ``_records``/``retried_digests`` fingerprint spines are run-long
+        by design and stay off the ledger."""
+        from ..observability.telemetry import SizedResource
+
+        return (
+            SizedResource(prefix + "attempts",
+                          lambda: len(self._attempts),
+                          bound=None, entry_bytes=96),
+            SizedResource(prefix + "outstanding",
+                          lambda: self.outstanding,
+                          bound=None, entry_bytes=512),
+        )
+
     # ------------------------------------------------------------------
 
     def on_shed(self, req: Any, client_id: Optional[str],

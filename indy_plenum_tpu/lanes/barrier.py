@@ -261,6 +261,25 @@ class CrossLaneBarrier:
 
     # ------------------------------------------------------------------
 
+    def sized_resources(self, prefix: str = "barrier."):
+        """Resource-ledger registration (observability.telemetry): the
+        sealed-window records (bounded by ``keep``; keep=0 retains
+        everything by design — the leak law still watches it) and the
+        held-open in-flight window state."""
+        from ..observability.telemetry import SizedResource
+
+        bound = self.keep if self.keep > 0 else None
+        return (
+            SizedResource(prefix + "seal_digests",
+                          lambda: len(self.seal_digests),
+                          bound=bound, entry_bytes=256),
+            SizedResource(prefix + "fingerprints",
+                          lambda: len(self.fingerprints),
+                          bound=bound, entry_bytes=128),
+            SizedResource(prefix + "held", lambda: len(self._held),
+                          bound=None, entry_bytes=128),
+        )
+
     def counters(self) -> dict:
         return {
             "lanes": self.lanes,

@@ -10,6 +10,11 @@ from .causal import (  # noqa: F401
     span_id,
     trace_id,
 )
+from .telemetry import (  # noqa: F401
+    ResourceLedger,
+    SizedResource,
+    TelemetryPlane,
+)
 from .trace import (  # noqa: F401
     NULL_TRACE,
     NullTraceRecorder,

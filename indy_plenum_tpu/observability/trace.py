@@ -167,6 +167,21 @@ class TraceRecorder:
     def __len__(self) -> int:
         return len(self._events)
 
+    def sized_resources(self, prefix: str = "trace."):
+        """Resource-ledger registration (observability.telemetry): the
+        ring and the flight-dump deque are the recorder's two bounded
+        stores."""
+        from .telemetry import SizedResource
+
+        return (
+            SizedResource(prefix + "ring", lambda: len(self._events),
+                          bound=self._events.maxlen, entry_bytes=120,
+                          ring=True),
+            SizedResource(prefix + "dumps", lambda: len(self.dumps),
+                          bound=self.dumps.maxlen, entry_bytes=16384,
+                          ring=True),
+        )
+
     def __bool__(self) -> bool:
         # a recorder is never falsy: with __len__ defined, an enabled
         # but still-empty ring would otherwise fail `trace or NULL_TRACE`
@@ -673,6 +688,62 @@ def overlap_report(events: List[Dict[str, Any]],
                            else 0.0 for v in cell_votes],
         }
     return out
+
+
+def rollup_report(events: List[Dict[str, Any]],
+                  node: Optional[str] = None) -> Dict[str, Any]:
+    """The telemetry plane's windowed-rollup view from a flight dump
+    alone (``trace_tool.py --rollups`` — the long-horizon sibling of
+    ``--overlap``).
+
+    An armed plane records one ``telemetry.roll`` mark per rolled
+    window (ordered/shed/retry deltas, window p99, summed and largest
+    per-resource high-water) and a ``flight.telemetry.<law>`` mark per
+    fired anomaly (the drift detector's ``trigger_dump``). The report
+    rebuilds the per-window table, joins each anomaly to its window,
+    and totals anomalies per law — so a dump from a soak run answers
+    "when did throughput drift, and what was growing" without the
+    run's in-memory plane."""
+    rows: List[Dict[str, Any]] = []
+    by_window: Dict[int, Dict[str, Any]] = {}
+    for ev in events:
+        if ev.get("name") != "telemetry.roll":
+            continue
+        if node is not None and ev.get("node", "") not in ("", node):
+            continue
+        row = dict(ev.get("args") or {})
+        row["ts"] = ev.get("ts")
+        row["anomalies"] = []
+        rows.append(row)
+        if row.get("window") is not None:
+            by_window[int(row["window"])] = row
+    anomalies: List[Dict[str, Any]] = []
+    by_law: Dict[str, int] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if ev.get("cat") != "flight" \
+                or not name.startswith("flight.telemetry."):
+            continue
+        law = name[len("flight.telemetry."):]
+        rec = dict(ev.get("args") or {})
+        rec["law"] = law
+        rec["ts"] = ev.get("ts")
+        anomalies.append(rec)
+        by_law[law] = by_law.get(law, 0) + 1
+        w = rec.get("window")
+        if w is not None and int(w) in by_window:
+            by_window[int(w)]["anomalies"].append(law)
+    ordered = [r.get("ordered") or 0 for r in rows]
+    return {
+        "windows": len(rows),
+        "ordered_total": sum(ordered),
+        "ordered_min": min(ordered) if ordered else 0,
+        "ordered_max": max(ordered) if ordered else 0,
+        "anomaly_count": len(anomalies),
+        "anomalies_by_law": dict(sorted(by_law.items())),
+        "anomalies": anomalies,
+        "per_window": rows,
+    }
 
 
 # ----------------------------------------------------------------------

@@ -259,6 +259,19 @@ class CheckpointProofCache:
                 key=entry.window, args={"batch": batch})
         return entry
 
+    def sized_resources(self, prefix: str = "proof_cache."):
+        """Resource-ledger registration (observability.telemetry):
+        servable windows and half-signed pending windows, both bounded
+        by ``keep``."""
+        from ..observability.telemetry import SizedResource
+
+        return (
+            SizedResource(prefix + "windows", lambda: len(self._entries),
+                          bound=self.keep, entry_bytes=512),
+            SizedResource(prefix + "pending", lambda: len(self._pending),
+                          bound=self.keep, entry_bytes=512),
+        )
+
     def counters(self) -> Dict[str, int]:
         return {
             "windows_signed": self.windows_signed,

@@ -262,6 +262,18 @@ class EdgeProofCache:
         newest = next(reversed(self._windows.values()))
         return now - newest["replicated_at"]
 
+    def sized_resources(self, prefix: str = "edge_cache."):
+        """Resource-ledger registration (observability.telemetry): the
+        window buckets (keep_windows) and the reply LRU (max_entries)."""
+        from ..observability.telemetry import SizedResource
+
+        return (
+            SizedResource(prefix + "windows", lambda: len(self._windows),
+                          bound=self.keep_windows, entry_bytes=256),
+            SizedResource(prefix + "lru", lambda: len(self._lru),
+                          bound=self.max_entries, entry_bytes=1024),
+        )
+
     def counters(self) -> Dict[str, object]:
         lookups = self.hits + self.misses
         return {

@@ -337,6 +337,33 @@ class Monitor:
                 if barrier:
                     lanes["barrier"] = barrier
                 snap["lanes"] = lanes
+            # long-horizon telemetry plane (observability/telemetry.py):
+            # rolled-window / fired-anomaly meters plus per-resource
+            # occupancy gauges (last = at the latest roll, high_water =
+            # max over rolls) — absent entirely when the run never
+            # armed the plane (TelemetryWindowSec = 0)
+            windows = self._metrics.stat(MetricsName.TELEMETRY_WINDOWS)
+            if windows is not None and windows.count:
+                from ..observability.telemetry import (
+                    RESOURCE_METRIC_PREFIX,
+                )
+
+                telemetry: Dict[str, object] = {
+                    "windows": int(windows.total)}
+                fired = self._metrics.stat(
+                    MetricsName.TELEMETRY_ANOMALIES)
+                telemetry["anomalies"] = \
+                    int(fired.total) if fired is not None else 0
+                resources: Dict[str, object] = {}
+                for name, stat in self._metrics.summary().items():
+                    if name.startswith(RESOURCE_METRIC_PREFIX):
+                        resources[name[len(RESOURCE_METRIC_PREFIX):]] = {
+                            "last": int(stat["last"]),
+                            "high_water": int(stat["max"]),
+                        }
+                if resources:
+                    telemetry["resources"] = resources
+                snap["telemetry"] = telemetry
         if self._trace is not None and self._trace.enabled:
             # per-phase latency attribution (flight recorder): where this
             # node's ordered batches spent their time — prepare / commit

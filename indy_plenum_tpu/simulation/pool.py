@@ -390,6 +390,53 @@ class SimNode:
                 for s in range(1, ledger.size + 1)]
 
 
+class _TelemetryTap:
+    """The telemetry plane's deterministic consensus tap: per-node
+    executed-txn tallies (mirroring :meth:`SimNode._on_ordered`'s
+    re-order dedupe so the count means *executed*, not delivered), e2e
+    latency samples (virtual pre-prepare -> executed seconds), and the
+    window pulses that roll rollup boundaries — all driven by internal
+    bus events at virtual instants, so every series replays
+    byte-identically per seed."""
+
+    def __init__(self, plane, clock):
+        self.plane = plane
+        self.clock = clock
+        self.txns: Dict[str, int] = {}
+        self._upto: Dict[str, int] = {}
+
+    def attach(self, node) -> None:
+        from ..common.messages.internal_messages import CheckpointStabilized
+
+        self.txns[node.name] = 0
+        self._upto[node.name] = 0
+        node.internal_bus.subscribe(
+            Ordered,
+            lambda o, *a, _n=node.name: self._on_ordered(_n, o))
+        node.internal_bus.subscribe(CheckpointStabilized,
+                                    self._on_stabilized)
+
+    def _on_ordered(self, name: str, ordered) -> None:
+        if ordered.ppSeqNo <= self._upto[name]:
+            return  # re-ordered after view change; already executed
+        self._upto[name] = ordered.ppSeqNo
+        self.txns[name] += len(ordered.reqIdr)
+        now = self.clock()
+        self.plane.observe_latency(now - ordered.ppTime)
+        self.plane.pulse(now)
+
+    def _on_stabilized(self, msg, *args) -> None:
+        if msg.inst_id != 0:
+            return  # master instance only, like the proof cache
+        self.plane.pulse(self.clock())
+
+    def ordered_txns(self) -> int:
+        """Pool progress = the max per-node tally: a crashed node's
+        stalled counter (its gap arrives via catchup, not Ordered) must
+        not read as pool throughput loss."""
+        return max(self.txns.values()) if self.txns else 0
+
+
 class SimPool:
     def __init__(self, n_nodes: int = 4, seed: int = 0,
                  config: Optional[Config] = None,
@@ -651,6 +698,18 @@ class SimPool:
         self.governor = getattr(self._quorum_tick_timer, "governor", None)
         # occupancy-driven rebalance policy (None unless sharded + armed)
         self.rebalance = getattr(self._quorum_tick_timer, "rebalance", None)
+        # long-horizon telemetry plane (observability/telemetry.py):
+        # TelemetryWindowSec > 0 registers every bounded structure in ONE
+        # resource ledger and rolls windowed series off deterministic
+        # consensus pulses; unarmed pools pay nothing (no ledger, no bus
+        # subscribers). Pools that delegate their tick (drive_ticks=False,
+        # the multi-lane composition) leave arming to the composer.
+        self.resource_ledger = None
+        self.telemetry = None
+        self._telemetry_tap = None
+        self._read_backing_seq = 0
+        if drive_ticks and self.config.TelemetryWindowSec > 0:
+            self._arm_telemetry()
 
     def _install_accounting(self, node: "SimNode") -> None:
         import time as _time
@@ -863,6 +922,69 @@ class SimPool:
             retry_pressure=(self.retry.outstanding
                             if self.retry is not None else 0))
 
+    def _arm_telemetry(self) -> None:
+        """Build the resource ledger + telemetry plane and register every
+        bounded structure the pool composed: trace rings, metrics
+        histograms, admission queue, retry cohort, per-node proof caches,
+        SMT node caches / dirty overlays, staged write batches and
+        request queues. Series: ordered txns (the tap's max-node tally),
+        shed/retry counters, governor occupancy EWMA."""
+        from ..observability.telemetry import (
+            ResourceLedger,
+            SizedResource,
+            TelemetryPlane,
+        )
+
+        ledger = ResourceLedger()
+        plane = TelemetryPlane.from_config(
+            self.config, ledger, t0=self.timer.get_current_time(),
+            metrics=self.metrics, trace=self.trace)
+        self.resource_ledger = ledger
+        self.telemetry = plane
+        if self.trace.enabled:
+            ledger.register_all(self.trace.sized_resources())
+        ledger.register_all(self.metrics.sized_resources())
+        if self.admission is not None:
+            ledger.register_all(self.admission.sized_resources())
+        if self.retry is not None:
+            ledger.register_all(self.retry.sized_resources())
+        for nd in self.nodes:
+            p = nd.name + "."
+            ledger.register(SizedResource(
+                p + "requests_queue",
+                (lambda _q=self.requests._queues, _n=nd.name:
+                 len(_q.get(_n, ()))),
+                bound=None, entry_bytes=64))
+            if nd.proof_cache is not None:
+                ledger.register_all(
+                    nd.proof_cache.sized_resources(p + "proof_cache."))
+            if nd.boot is not None:
+                state = nd.boot.db.get_state(DOMAIN_LEDGER_ID)
+                if state is not None and hasattr(state, "sized_resources"):
+                    ledger.register_all(
+                        state.sized_resources(p + "state."))
+                wm = nd.boot.write_manager
+                if hasattr(wm, "_staged"):
+                    ledger.register(SizedResource(
+                        p + "staged_batches",
+                        (lambda _w=wm: len(_w._staged)),
+                        bound=None, entry_bytes=256))
+        tap = _TelemetryTap(plane, self.timer.get_current_time)
+        for nd in self.nodes:
+            tap.attach(nd)
+        self._telemetry_tap = tap
+        plane.add_counter("ordered", tap.ordered_txns)
+        plane.add_counter(
+            "shed", lambda: (self.admission.shed_total
+                             if self.admission is not None else 0))
+        plane.add_counter(
+            "retry", lambda: (self.retry.reoffers_total
+                              if self.retry is not None else 0))
+        plane.add_gauge(
+            "occupancy_ewma",
+            lambda: (float(self.governor.ewma)
+                     if self.governor is not None else 0.0))
+
     def make_read_service(self, name: str = "node0", mode: str = "host",
                           capacity: int = 0,
                           region: Optional[int] = None):
@@ -884,6 +1006,12 @@ class SimPool:
         backing = LedgerBacking(
             node.boot.db.get_ledger(DOMAIN_LEDGER_ID),
             bus=node.internal_bus)
+        if self.resource_ledger is not None:
+            # telemetry armed: late-built read backings join the ledger
+            # too (ordinal-prefixed — a bench may build several per node)
+            self._read_backing_seq += 1
+            self.resource_ledger.register_all(backing.sized_resources(
+                f"{name}.read_backing{self._read_backing_seq}."))
         return ReadService(
             backing, clock=self.timer.get_current_time,
             metrics=self.metrics, trace=self.trace, mode=mode,

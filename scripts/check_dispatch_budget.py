@@ -142,6 +142,17 @@ threshold, and a forced mid-run plane migration on the 4-way member
 mesh (executed at a checkpoint-boundary barrier) must keep the ordered
 digests bit-identical to the never-rebalanced arm.
 
+Soak gate (PR 20): unless ``--no-soak-gate``, the script runs the
+virtual-day soak (simulation/soak.py) — 24 simulated diurnal hours on a
+real-execution pool with ONE chaos arc (a GC-crossing crash + catchup
+at hour 6, a view change at hour 12, and a forced shard rebalance on
+hosts with >= 4 XLA devices) — twice on one seed, and fails if resource
+high-water is not flat after hour 1, hour-1 vs hour-24 ordered
+throughput drifts >= ``--soak-drift-tolerance`` (1%), any telemetry
+anomaly is unexplained by the chaos windows, any declared bound is
+violated, the runs are not byte-identical, or a short arm with a
+planted leaking resource does NOT trip the leak law (non-vacuity).
+
 Running one gate: ``--only latency`` (or ``--only trace,latency``)
 replaces stacking nine ``--no-*-gate`` flags; ``--list-gates`` prints
 the names.
@@ -168,7 +179,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # calibrated on the unmodified topology and must keep measuring there.
 if ("--no-sharded-gate" not in sys.argv
         or "--no-fabric-gate" not in sys.argv
-        or "--no-residency-gate" not in sys.argv):
+        or "--no-residency-gate" not in sys.argv
+        or "--no-soak-gate" not in sys.argv):
     from indy_plenum_tpu.utils.jax_env import ensure_host_platform_devices
 
     _width = 4
@@ -1700,6 +1712,110 @@ def residency_gate(args) -> "tuple[dict, list]":
     return record, failures
 
 
+def soak_gate(args) -> "tuple[dict, list]":
+    """Virtual-day soak gate (simulation/soak.py, ISSUE 20): the
+    24-simulated-hour diurnal arc on a real-execution pool with the
+    chaos folded into ONE day — a GC-crossing crash + catchup at hour
+    6, a view change at hour 12, and (on hosts with >= 4 XLA devices,
+    where the pool runs tick-batched on a quorum fabric) one forced
+    shard rebalance — judged entirely by the telemetry plane:
+
+    1. resource high-water FLAT after hour 1 (tail windows vs the
+       baseline that contains the whole chaos arc);
+    2. hour-1 -> hour-24 ordered-throughput drift < ``--soak-drift-
+       tolerance`` (default 1%: the deterministic arrival grid makes
+       both hours' offered load byte-identical, so drift is the
+       system's);
+    3. ZERO unexplained anomalies (chaos-window anomalies are
+       classified explained; bound violations never are) and zero
+       bound violations;
+    4. the whole artifact — ordered hash, state head, hourly tallies,
+       telemetry hash chain — byte-identical across two same-seed runs;
+    5. non-vacuity: a short arm with a deliberately registered leaking
+       resource MUST trip the leak law (the detector is proven live,
+       not just silent).
+    """
+    from indy_plenum_tpu.simulation.soak import run_day_soak
+
+    failures = []
+    soak = run_day_soak(hours=args.soak_hours, rate=args.soak_rate,
+                        seed=args.soak_seed, repeats=2)
+    if not soak["deterministic"]:
+        failures.append("day soak: same-seed runs not byte-identical")
+    if not soak["agree"]:
+        failures.append("day soak: ledgers diverged across the chaos arc")
+    if not soak["flat_high_water"]:
+        grew = {n: (soak["first_high_water"][n],
+                    soak["last_high_water"][n])
+                for n in soak["first_high_water"]
+                if soak["last_high_water"][n]
+                > soak["first_high_water"][n] * 1.2}
+        failures.append(
+            f"day soak: resource high-water grew past hour 1: {grew}")
+    if soak["throughput_drift"] >= args.soak_drift_tolerance:
+        failures.append(
+            f"day soak: ordered-throughput drift "
+            f"{soak['throughput_drift']:.2%} >= "
+            f"{args.soak_drift_tolerance:.0%} hour-1 vs hour-24")
+    if soak["anomalies_unexplained"]:
+        failures.append(
+            f"day soak: {soak['anomalies_unexplained']} unexplained "
+            f"telemetry anomalies: {soak['unexplained']}")
+    if soak["bound_violations"]:
+        failures.append(
+            f"day soak: declared bounds violated: "
+            f"{soak['bound_violations']}")
+    chaos = soak["chaos"]
+    if chaos["crash"] is not None and not chaos["crash"]["ok"]:
+        failures.append(
+            f"day soak: crash/catchup leg failed: {chaos['crash']}")
+    if chaos["view_change"] is not None \
+            and not chaos["view_change"]["ok"]:
+        failures.append(
+            f"day soak: view-change leg failed: {chaos['view_change']}")
+    if chaos["rebalance"]["armed"] and not chaos["rebalance"]["ok"]:
+        failures.append(
+            f"day soak: forced-rebalance leg never planned: "
+            f"{chaos['rebalance']}")
+
+    # non-vacuity: the leak law must CATCH a planted leak — otherwise
+    # "zero anomalies" above proves nothing. EVERY chaos leg is pushed
+    # out of range (rebalance_tick=0 included: a forced rotation's
+    # explained-anomaly window would swallow the planted leak's)
+    leak = run_day_soak(hours=4.0, rate=args.soak_rate,
+                        seed=args.soak_seed, crash_hour=99.0,
+                        vc_hour=99.0, rebalance_tick=0, repeats=1,
+                        synthetic_leak=True)
+    caught = [a for a in leak["unexplained"]
+              if a["law"] == "resource_leak"
+              and a.get("resource") == "soak.synthetic_leak"]
+    if not caught:
+        failures.append(
+            "day soak: the leak law never caught the planted "
+            "synthetic leak (detector is vacuous) — anomalies: "
+            f"{leak['unexplained']}")
+
+    record = {
+        "soak": {k: soak[k] for k in (
+            "hours", "rate", "seed", "device_arm", "arrivals",
+            "ordered_total", "hourly_ordered", "throughput_drift",
+            "flat_high_water", "windows", "anomalies",
+            "anomalies_unexplained", "unexplained", "bound_violations",
+            "chaos", "agree", "telemetry_hash", "fingerprint",
+            "deterministic", "wall_s")},
+        "drift_tolerance": args.soak_drift_tolerance,
+        "rebalance_leg": ("ran" if chaos["rebalance"]["armed"]
+                          else "skipped (needs >= 4 XLA devices)"),
+        "leak_arm": {
+            "caught": bool(caught),
+            "caught_at_window": caught[0]["window"] if caught else None,
+            "anomalies": leak["anomalies"],
+            "wall_s": leak["wall_s"],
+        },
+    }
+    return record, failures
+
+
 def _predicted_heat(heat, rows, shard_rows):
     """The policy's own placement model: rotating by ``rows`` device
     rows splits each block's load proportionally between the blocks
@@ -1751,6 +1867,13 @@ GATES = {
                   "digest identity, <=1 dispatch/ordered batch, "
                   "synthetic un-skew law, forced plane migration with "
                   "unchanged digests"),
+    "soak": ("no_soak_gate",
+             "virtual-day soak: 24 simulated diurnal hours with one "
+             "chaos arc (GC-crossing crash+catchup, view change, "
+             "forced rebalance), flat resource high-water after hour "
+             "1, <1% hour-1-vs-24 ordered drift, zero unexplained "
+             "anomalies, byte-identical telemetry hash across two "
+             "same-seed runs, leak-law non-vacuity"),
 }
 
 
@@ -1869,6 +1992,22 @@ def main() -> int:
     ap.add_argument("--geo-hit-floor", type=float, default=0.90,
                     help="min fraction of storm reads the edge arm "
                          "must serve from region-local edge caches")
+    ap.add_argument("--no-soak-gate", action="store_true",
+                    help="skip the virtual-day soak gate (24 simulated "
+                         "diurnal hours with one chaos arc, judged by "
+                         "the telemetry plane; two same-seed runs + a "
+                         "leak-law non-vacuity arm)")
+    ap.add_argument("--soak-hours", type=float, default=None,
+                    help="virtual hours for the day soak (default: the "
+                         "SoakHours config knob, 24)")
+    ap.add_argument("--soak-rate", type=float, default=None,
+                    help="base arrivals/sim-second for the soak's "
+                         "diurnal grid (default: the SoakRate knob)")
+    ap.add_argument("--soak-seed", type=int, default=17,
+                    help="seed for the day soak's two same-seed runs")
+    ap.add_argument("--soak-drift-tolerance", type=float, default=0.01,
+                    help="max hour-1 vs hour-24 ordered-throughput "
+                         "drift for the day soak")
     ap.add_argument("--only", default=None, metavar="GATE[,GATE]",
                     help="run ONLY the named gate(s) — e.g. '--only "
                          "latency' instead of stacking nine --no-*-gate "
@@ -2031,6 +2170,10 @@ def main() -> int:
     if not args.no_residency_gate:
         record, failures = residency_gate(args)
         result["residency_gate"] = record
+        over.extend(failures)
+    if not args.no_soak_gate:
+        record, failures = soak_gate(args)
+        result["soak_gate"] = record
         over.extend(failures)
     result["verdict"] = "FAIL: " + "; ".join(over) if over else "PASS"
     if args.json:

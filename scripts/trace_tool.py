@@ -60,6 +60,7 @@ from indy_plenum_tpu.observability.trace import (  # noqa: E402
     load_jsonl,
     overlap_report,
     phase_percentiles,
+    rollup_report,
     to_chrome_trace,
 )
 
@@ -188,6 +189,10 @@ def main() -> int:
     ap.add_argument("--overlap", action="store_true",
                     help="per-tick host/device overlap fraction + "
                          "readback-bytes column (ordering fast path)")
+    ap.add_argument("--rollups", action="store_true",
+                    help="telemetry windowed rollups: per-window "
+                         "ordered/shed/p99/high-water table with drift "
+                         "anomaly marks (long-horizon soak dumps)")
     ap.add_argument("--journeys", action="store_true",
                     help="causal journey table: per-request cross-node "
                          "e2e latency with network/queue/compute/device "
@@ -238,13 +243,17 @@ def main() -> int:
         _print_journey(detail)
         return 0
     view_selected = (args.phases or args.critical_path or args.overlap
-                     or args.journeys)
+                     or args.rollups or args.journeys)
     if args.phases or not view_selected:
         record["phase_latency"] = phase_percentiles(events, node=args.node)
     if args.critical_path or not view_selected:
         record["critical_path"] = critical_path(events, node=args.node)
     if args.overlap or not view_selected:
         record["overlap"] = overlap_report(events, node=args.node)
+    if args.rollups or not view_selected:
+        rollups = rollup_report(events, node=args.node)
+        if rollups["windows"] or args.rollups:
+            record["rollups"] = rollups
     if args.journeys or not view_selected:
         built = build_journeys(events)
         record["journeys"] = journey_summary(events, built=built)
@@ -334,6 +343,35 @@ def main() -> int:
             for c, (v, sh) in enumerate(zip(ps["votes"],
                                             ps["vote_share"])):
                 print(f"  {c:>12d} {v:>9d} {sh:>9.2%}")
+    if "rollups" in record:
+        ru = record["rollups"]
+        laws = ", ".join(f"{k}={v}" for k, v in
+                         ru["anomalies_by_law"].items()) or "none"
+        print(f"telemetry rollups over {ru['windows']} windows: "
+              f"ordered total={ru['ordered_total']} "
+              f"(min={ru['ordered_min']} max={ru['ordered_max']} "
+              f"per window), anomalies={ru['anomaly_count']} ({laws})")
+        if args.rollups:
+            print(f"  {'window':>6s} {'ts':>14s} {'ordered':>8s} "
+                  f"{'shed':>6s} {'retry':>6s} {'p99':>10s} "
+                  f"{'hw_total':>9s} {'largest resource':<28s} anomalies")
+            for r in ru["per_window"]:
+                p99 = f"{r['p99']:.4f}" if r.get("p99") is not None \
+                    else "-"
+                top = (f"{r.get('hw_top') or '-'}"
+                       f"={r.get('hw_top_entries', 0)}")
+                marks = ",".join(r["anomalies"]) if r["anomalies"] else ""
+                print(f"  {r.get('window', 0):>6d} "
+                      f"{r.get('ts', 0):>14.3f} "
+                      f"{r.get('ordered') or 0:>8d} "
+                      f"{r.get('shed') or 0:>6d} "
+                      f"{r.get('retry') or 0:>6d} {p99:>10s} "
+                      f"{r.get('hw_total', 0):>9d} {top:<28s} {marks}")
+            for a in ru["anomalies"]:
+                detail = {k: v for k, v in a.items()
+                          if k not in ("law", "ts", "window")}
+                print(f"  anomaly t={a['ts']:.3f} w={a.get('window')} "
+                      f"{a['law']} {detail}")
     if "journeys" in record:
         _print_journey_table(record)
     if record.get("flight_events"):

@@ -1,0 +1,387 @@
+"""Long-horizon telemetry plane (observability.telemetry): resource
+ledger bounds, windowed rollups, the deterministic drift laws, and the
+virtual-day soak that exercises them end-to-end.
+
+The contract under test is the README "Long-horizon telemetry & soak"
+one: every bounded structure registers in ONE ledger (exceeding a
+declared bound is a hard anomaly), rollups are byte-identical per seed
+(``telemetry_hash`` chains rows and anomalies like the barrier's seal
+fingerprint), and the three drift laws — throughput drift, the leak
+law, latency creep — fire deterministically, once per episode, with a
+flight dump each.
+"""
+import pytest
+
+from indy_plenum_tpu.config import getConfig
+from indy_plenum_tpu.observability.telemetry import (
+    ResourceLedger,
+    SizedResource,
+    TelemetryPlane,
+)
+from indy_plenum_tpu.observability.trace import TraceRecorder
+from indy_plenum_tpu.simulation.pool import SimPool
+
+
+class FakeClock:
+    def __init__(self, start=0.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+# ----------------------------------------------------------------------
+# resource ledger units
+# ----------------------------------------------------------------------
+
+def test_ledger_tracks_current_window_and_running_high_water():
+    ledger = ResourceLedger()
+    box = []
+    ledger.register(SizedResource("box", lambda: len(box), bound=8))
+    for n in (3, 7, 2):
+        del box[:]
+        box.extend(range(n))
+        assert ledger.sample() == []
+    assert ledger.current("box") == 2
+    assert ledger.high_water("box") == 7
+    assert ledger.window_high_water() == {"box": 7}
+    ledger.reset_window()
+    ledger.sample()
+    # window high-water restarts; the running one does not
+    assert ledger.window_high_water() == {"box": 2}
+    assert ledger.high_water("box") == 7
+    snap = ledger.snapshot()["box"]
+    assert snap["bound"] == 8 and snap["entries"] == 2
+    assert snap["approx_bytes"] == 2 * 64
+    # names are unique: double registration is a wiring bug, not a merge
+    with pytest.raises(ValueError):
+        ledger.register(SizedResource("box", lambda: 0))
+
+
+def test_bound_exceedance_is_reported_by_sample():
+    ledger = ResourceLedger()
+    ledger.register(SizedResource("small", lambda: 5, bound=3))
+    ledger.register(SizedResource("free", lambda: 10 ** 6, bound=None))
+    violations = ledger.sample()
+    # unbounded resources never violate; bounded ones name the overrun
+    assert violations == ["small entries=5 over bound=3"]
+
+
+# ----------------------------------------------------------------------
+# plane units: rolls, hash chain, bound-violation anomaly
+# ----------------------------------------------------------------------
+
+def _plane(ledger=None, trace=None, **kw):
+    kw.setdefault("window_sec", 1.0)
+    kw.setdefault("leak_grace", 0)
+    kw.setdefault("drift_lag", 1)
+    return TelemetryPlane(ledger or ResourceLedger(), t0=0.0,
+                          trace=trace, **kw)
+
+
+def test_windows_roll_on_boundaries_with_counter_deltas():
+    plane = _plane()
+    total = [0]
+    plane.add_counter("ordered", lambda: total[0])
+    plane.add_gauge("g", lambda: 0.25)
+    total[0] = 4
+    plane.pulse(0.5)           # mid-window: nothing rolls
+    assert plane.completed == 0
+    total[0] = 10
+    plane.pulse(2.0)           # crosses w0 AND w1 in one pulse
+    assert plane.completed == 2
+    rows = list(plane.windows)
+    # deltas are per-window (cumulative counter differenced at rolls);
+    # both boundaries were crossed by one pulse, so w1 sees no growth
+    assert rows[0]["counters"]["ordered"] == 10
+    assert rows[1]["counters"]["ordered"] == 0
+    assert rows[0]["gauges"]["g"] == 0.25
+    assert rows[0]["t_end"] == 1.0 and rows[1]["t_end"] == 2.0
+
+
+def test_telemetry_hash_is_deterministic_and_data_sensitive():
+    def drive(values):
+        plane = _plane()
+        total = [0]
+        plane.add_counter("ordered", lambda: total[0])
+        for w, v in enumerate(values):
+            total[0] += v
+            plane.finalize(float(w + 1))
+        return plane.telemetry_hash
+
+    assert drive([5, 7, 3]) == drive([5, 7, 3])
+    assert drive([5, 7, 3]) != drive([5, 7, 4])
+
+
+def test_windows_ring_is_bounded_and_hash_survives_eviction():
+    plane = _plane(keep=4)
+    for w in range(12):
+        plane.pulse(float(w + 1))
+    assert len(plane.windows) == 4          # ring evicted 8 rows
+    assert plane.completed == 12            # but the count kept going
+    # the chain tip still differs from a shorter run: O(1) state, full
+    # history coverage
+    short = _plane(keep=4)
+    for w in range(11):
+        short.pulse(float(w + 1))
+    assert plane.telemetry_hash != short.telemetry_hash
+
+
+def test_bound_violation_fires_hard_anomaly_once_per_resource():
+    ledger = ResourceLedger()
+    size = [0]
+    ledger.register(SizedResource("leaky", lambda: size[0], bound=3))
+    plane = _plane(ledger)
+    size[0] = 5
+    plane.pulse(1.0)
+    plane.pulse(2.0)  # still over bound: no second anomaly
+    snap = plane.snapshot()
+    assert snap["bound_violations"] == ["leaky"]
+    fired = [a for a in snap["anomaly_tail"]
+             if a["law"] == "bound_violation"]
+    assert len(fired) == 1 and fired[0]["resource"] == "leaky"
+
+
+def test_plane_unarmed_when_window_knob_is_zero():
+    config = getConfig()
+    assert config.TelemetryWindowSec == 0.0  # default: the plane is off
+    assert TelemetryPlane.from_config(config, ResourceLedger(), 0.0) \
+        is None
+    with pytest.raises(ValueError):
+        _plane(window_sec=0.0)
+
+
+# ----------------------------------------------------------------------
+# drift laws: deterministic, episodic, grace-gated
+# ----------------------------------------------------------------------
+
+def test_leak_law_fires_after_streak_and_rearms_on_plateau():
+    ledger = ResourceLedger()
+    size = [0]
+    ledger.register(SizedResource("grow", lambda: size[0], bound=None))
+    plane = _plane(ledger, leak_windows=3, leak_grace=2)
+    hist = []
+
+    def window(delta):
+        size[0] += delta
+        plane.pulse(float(plane.completed + 1))
+        hist.append([a for a in plane.anomalies
+                     if a["law"] == "resource_leak"])
+
+    for _ in range(6):          # strictly increasing every window
+        window(+1)
+    leaks = hist[-1]
+    assert len(leaks) == 1, "one anomaly per episode, not per window"
+    rec = leaks[0]
+    assert rec["resource"] == "grow" and rec["streak"] == 3
+    # windows 0..1 were grace (no streak credit): streak 1 lands at w2,
+    # 3 at w4 — one later than the graceless w3
+    assert rec["window"] == 4
+    window(0)                   # plateau: episode re-arms
+    for _ in range(3):
+        window(+1)
+    assert len([a for a in plane.anomalies
+                if a["law"] == "resource_leak"]) == 2
+
+
+def test_leak_law_exempts_the_planes_own_rings():
+    """Resources registered ``ring=True`` (the plane's rollup rings, a
+    trace ring) grow one entry per event BY CONSTRUCTION until their
+    maxlen — that monotone ramp must not read as a leak (the
+    bound-violation law still covers them). A look-alike ramp that is
+    NOT flagged as a ring still fires."""
+    ledger = ResourceLedger()
+    grow = [0]
+    ledger.register(SizedResource("flagged.ring", lambda: grow[0],
+                                  bound=1000, ring=True))
+    ledger.register(SizedResource("unflagged.ramp", lambda: grow[0],
+                                  bound=1000))
+    plane = _plane(ledger=ledger, leak_windows=2, leak_grace=0)
+    for w in range(10):
+        grow[0] += 7
+        plane.pulse(float(w + 1))
+    assert plane.completed == 10
+    leaks = [a for a in plane.anomalies if a["law"] == "resource_leak"]
+    assert [a["resource"] for a in leaks] == ["unflagged.ramp"]
+
+
+def test_throughput_drift_law_respects_grace_and_episodes():
+    plane = _plane(leak_grace=4, drift_frac=0.5, drift_lag=1)
+    total = [0]
+    plane.add_counter("ordered", lambda: total[0])
+
+    def window(delta):
+        total[0] += delta
+        plane.pulse(float(plane.completed + 1))
+
+    # a >50% drop INSIDE the grace is warm-up, not drift
+    window(400)
+    window(40)
+    assert plane.anomaly_count == 0
+    for _ in range(4):
+        window(100)
+    window(10)                  # drop after grace: fires
+    drifts = [a for a in plane.anomalies if a["law"] == "throughput_drift"]
+    assert len(drifts) == 1
+    assert drifts[0]["ordered"] == 10 and drifts[0]["reference"] == 100
+    window(5)                   # still drifted: same episode, no refire
+    assert sum(a["law"] == "throughput_drift"
+               for a in plane.anomalies) == 1
+    window(100)                 # recovered: re-armed
+    window(10)
+    assert sum(a["law"] == "throughput_drift"
+               for a in plane.anomalies) == 2
+
+
+def test_latency_creep_law():
+    plane = _plane(leak_windows=3, leak_grace=0)
+
+    def window(p99):
+        plane.observe_latency(p99)
+        plane.pulse(float(plane.completed + 1))
+
+    for v in (0.1, 0.2, 0.3, 0.4):   # strictly increasing p99
+        window(v)
+    creeps = [a for a in plane.anomalies if a["law"] == "latency_creep"]
+    assert len(creeps) == 1 and creeps[0]["streak"] == 3
+
+
+def test_anomalies_trigger_bounded_flight_dumps_and_roll_marks():
+    clock = FakeClock()
+    rec = TraceRecorder(clock, capacity=256)
+    ledger = ResourceLedger()
+    size = [0]
+    ledger.register(SizedResource("grow", lambda: size[0]))
+    plane = _plane(ledger, trace=rec, leak_windows=2, leak_grace=0)
+    for w in range(4):
+        size[0] += 1
+        clock.now = float(w + 1)
+        plane.pulse(clock.now)
+    assert [d["reason"] for d in rec.dumps] == ["telemetry.resource_leak"]
+    rolls = [e for e in rec.events() if e["name"] == "telemetry.roll"]
+    assert len(rolls) == 4
+    assert rolls[0]["cat"] == "telemetry"
+    assert rolls[-1]["args"]["hw_top"] == "grow"
+
+
+# ----------------------------------------------------------------------
+# pool integration: arming, determinism, the monitor block
+# ----------------------------------------------------------------------
+
+def _armed_pool(seed, window_sec=1.0):
+    config = getConfig({
+        "Max3PCBatchWait": 0.1, "Max3PCBatchSize": 10,
+        "TelemetryWindowSec": window_sec, "TelemetryLeakGraceWindows": 2})
+    return SimPool(n_nodes=4, seed=seed, config=config, trace=True)
+
+
+def test_pool_rollups_deterministic_across_same_seed_runs():
+    def run():
+        pool = _armed_pool(seed=29)
+        for i in range(25):
+            pool.submit_request(i)
+        pool.run_for(20)
+        assert pool.honest_nodes_agree()
+        pool.telemetry.finalize(pool.timer.get_current_time())
+        return pool
+
+    p1, p2 = run(), run()
+    snap = p1.telemetry.snapshot()
+    assert snap["windows"] >= 10
+    assert snap["bound_violations"] == []
+    # every composed structure is on the ledger: trace rings, metrics
+    # histograms, per-node queues, the plane's own rings
+    names = p1.resource_ledger.names
+    assert "trace.ring" in names and "telemetry.windows" in names
+    assert any(n.startswith("node0.") for n in names)
+    # the rollup stream is a checkable artifact like ordered_hash
+    assert p1.telemetry.telemetry_hash == p2.telemetry.telemetry_hash
+    assert p1.ordered_hash() == p2.ordered_hash()
+    # ordered deltas in the rows sum to the pool's executed tally
+    total = sum(r["counters"]["ordered"] for r in p1.telemetry.windows)
+    assert total == p1._telemetry_tap.ordered_txns()
+    # each roll left a trace mark (trace_tool --rollups rebuilds from it)
+    rolls = [e for e in p1.trace.events()
+             if e["name"] == "telemetry.roll"]
+    assert len(rolls) == snap["windows"]
+
+
+def test_unarmed_pool_has_no_plane_and_pays_nothing():
+    pool = SimPool(n_nodes=4, seed=3)
+    assert pool.telemetry is None and pool.resource_ledger is None
+
+
+def test_monitor_snapshot_telemetry_block_shape():
+    """Satellite: Monitor.snapshot() surfaces the telemetry block —
+    window count, anomaly count, per-resource last/high-water — when the
+    plane is armed, and no block at all when it is not."""
+    from indy_plenum_tpu.common.event_bus import InternalBus
+    from indy_plenum_tpu.server.monitor import Monitor
+
+    pool = _armed_pool(seed=11)
+    # spread load over virtual time: windows only roll at pulses
+    # (ordered events), so a single burst would never cross a boundary
+    for i in range(15):
+        pool.submit_request(i)
+        pool.run_for(1)
+    monitor = Monitor("node0", pool.timer, InternalBus(), pool.config,
+                      num_instances=1, metrics=pool.metrics)
+    block = monitor.snapshot()["telemetry"]
+    assert block["windows"] == pool.telemetry.completed
+    assert block["anomalies"] == pool.telemetry.anomaly_count
+    resources = block["resources"]
+    assert "telemetry.windows" in resources
+    for stat in resources.values():
+        assert set(stat) == {"last", "high_water"}
+        assert stat["last"] <= stat["high_water"]
+    # an unarmed pool's monitor reports no telemetry block
+    plain = SimPool(4, seed=3)
+    mon2 = Monitor("node0", plain.timer, InternalBus(), plain.config,
+                   num_instances=1, metrics=plain.metrics)
+    assert "telemetry" not in mon2.snapshot()
+
+
+# ----------------------------------------------------------------------
+# slow lane: the virtual-day soak acceptance shapes
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_day_soak_slice_bit_identical_and_clean():
+    """Two same-seed soak slices (chaos pushed out of range) replay
+    byte-identically — fingerprint, telemetry_hash, hourly tallies —
+    with zero anomalies and flat high-water."""
+    from indy_plenum_tpu.simulation.soak import _day_soak_once
+
+    def run():
+        return _day_soak_once(hours=2.0, rate=0.1, seed=17, n_keys=200,
+                              crash_hour=99.0, crash_hours=1.0,
+                              vc_hour=99.0, rebalance_tick=0,
+                              window_sec=600.0)
+
+    r1, r2 = run(), run()
+    assert r1["fingerprint"] == r2["fingerprint"]
+    assert r1["telemetry_hash"] == r2["telemetry_hash"]
+    assert r1["hourly_ordered"] == r2["hourly_ordered"]
+    assert r1["agree"] and r1["flat_high_water"]
+    assert r1["anomalies_unexplained"] == 0
+    assert r1["bound_violations"] == []
+    assert r1["throughput_drift"] == 0.0  # deterministic arrival grid
+
+
+@pytest.mark.slow
+def test_day_soak_synthetic_leak_is_caught():
+    """Non-vacuity: a planted resource that grows one entry per slice
+    trips the leak law — and ONLY that law — as an unexplained anomaly
+    naming the planted resource."""
+    from indy_plenum_tpu.simulation.soak import _day_soak_once
+
+    rec = _day_soak_once(hours=4.0, rate=0.1, seed=17, n_keys=200,
+                         crash_hour=99.0, crash_hours=1.0,
+                         vc_hour=99.0, rebalance_tick=0,
+                         window_sec=600.0, synthetic_leak=True)
+    leaks = [a for a in rec["unexplained"]
+             if a["law"] == "resource_leak"
+             and a.get("resource") == "soak.synthetic_leak"]
+    assert leaks, rec["unexplained"]
+    assert not rec["flat_high_water"]  # the leak shows in the hw check
+    assert all(a["law"] == "resource_leak" for a in rec["unexplained"])
